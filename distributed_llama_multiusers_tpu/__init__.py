@@ -1,0 +1,18 @@
+"""distributed-llama-multiusers_tpu — TPU-native distributed multi-user LLM inference.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+`LatadosUnited/distributed-llama-MultiUsers` (C++/TCP tensor-parallel Llama
+inference with a multi-user continuous-batching server):
+
+- Q40/Q80 block quantization and the `.m` / `.t` binary formats
+  (reference: src/nn/nn-quants.cpp, src/llm.cpp, src/tokenizer.cpp)
+- a pure-functional Llama model compiled by XLA, with quantized weights
+  (reference: src/llm.cpp buildLlmNet)
+- tensor/data/sequence parallelism over a `jax.sharding.Mesh` with XLA
+  collectives over ICI in place of the reference's full-mesh TCP
+  (reference: src/nn/nn-network.cpp)
+- a lane-based continuous-batching engine + OpenAI-ish HTTP server
+  (reference: src/Request.hpp, src/app.cpp inference_loop, src/dllama-api.cpp)
+"""
+
+__version__ = "0.1.0"

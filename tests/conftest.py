@@ -1,0 +1,35 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip sharding is validated without TPU hardware the same way the
+reference validates multi-node without a cluster (its NnFakeNodeSynchronizer
++ local process clusters, src/nn/nn-executor.cpp:6-8, examples/n-workers.sh):
+here, XLA's host platform is split into 8 virtual devices and the real
+collectives run through the same GSPMD paths they would take over ICI.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_model(tmp_path_factory):
+    """A tiny Q40 .m + .t pair on disk, shared across the session."""
+    from distributed_llama_multiusers_tpu.formats.synthetic import (
+        tiny_header,
+        write_synthetic_model,
+        write_synthetic_tokenizer,
+    )
+
+    d = tmp_path_factory.mktemp("tiny_model")
+    header = tiny_header()
+    model_path = str(d / "model.m")
+    tok_path = str(d / "tokenizer.t")
+    write_synthetic_model(model_path, header, seed=0)
+    write_synthetic_tokenizer(tok_path, vocab_size=header.vocab_size)
+    return {"model": model_path, "tokenizer": tok_path, "header": header}

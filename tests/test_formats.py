@@ -1,0 +1,53 @@
+import numpy as np
+
+from distributed_llama_multiusers_tpu.formats import (
+    load_model_header,
+    load_tokenizer_file,
+)
+from distributed_llama_multiusers_tpu.formats.model_file import model_tensor_specs, iter_model_tensors
+
+
+def test_model_header_roundtrip(tiny_model):
+    h0 = tiny_model["header"]
+    h = load_model_header(tiny_model["model"])
+    assert h.dim == h0.dim
+    assert h.hidden_dim == h0.hidden_dim
+    assert h.n_layers == h0.n_layers
+    assert h.n_heads == h0.n_heads
+    assert h.n_kv_heads == h0.n_kv_heads
+    assert h.vocab_size == h0.vocab_size
+    assert h.seq_len == h0.seq_len
+    assert h.weight_type == h0.weight_type
+    assert h.kv_dim == (h0.dim * h0.n_kv_heads) // h0.n_heads
+
+
+def test_max_seq_len_clamp(tiny_model):
+    # src/llm.cpp:89-91
+    h = load_model_header(tiny_model["model"], max_seq_len=16)
+    assert h.seq_len == 16
+    assert h.orig_seq_len == tiny_model["header"].seq_len
+
+
+def test_tensor_walk_consumes_whole_file(tiny_model):
+    h = load_model_header(tiny_model["model"])
+    specs = model_tensor_specs(h)
+    assert specs[-1].offset + specs[-1].n_bytes == h.file_size
+    names = [s.name for s in specs]
+    assert names[0] == "embedding"
+    assert names[-1] == "final_matmul_logits"
+    count = 0
+    for spec, raw in iter_model_tensors(tiny_model["model"], h):
+        assert raw.nbytes == spec.n_bytes
+        count += 1
+    assert count == len(specs)
+
+
+def test_tokenizer_roundtrip(tiny_model):
+    t = load_tokenizer_file(tiny_model["tokenizer"])
+    assert t.vocab_size == tiny_model["header"].vocab_size
+    assert t.bos_id >= 0
+    assert t.vocab[t.bos_id] == b"<|begin_of_text|>"
+    assert len(t.eos_token_ids) == 1
+    assert t.vocab[t.eos_token_ids[0]] == b"<|eot_id|>"
+    assert "<|start_header_id|>" in t.chat_template
+    assert t.max_token_length == max(len(v) for v in t.vocab)
