@@ -103,7 +103,7 @@ def write_synthetic_tokenizer(path: str, vocab_size: int = 128) -> TokenizerData
     scores: list[float] = []
     # 0..255 single bytes, score 0 — but keep it small: printable ASCII only
     base = [bytes([b]) for b in range(32, 127)]
-    merges = [b"he", b"ll", b"hell", b"hello", b"wo", b"rl", b"world", b"lo "]
+    merges = [b"he", b"ll", b"hell", b"hello", b"wo", b"rl", b"worl", b"world", b"lo "]
     for t in base:
         vocab.append(t)
         scores.append(0.0)

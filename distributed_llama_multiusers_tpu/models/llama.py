@@ -1,0 +1,161 @@
+"""Pure-functional Llama forward pass, designed for XLA/TPU.
+
+This is the TPU-native re-design of the reference's graph builder
+(src/llm.cpp:126-438). The reference emits a per-node static op list with
+explicit sync points; here the entire decode step is ONE traced function —
+layers run under ``lax.scan`` (compile-time O(1) in depth), tensor-parallel
+slicing is expressed as sharding annotations (see ``parallel/sharding.py``)
+and XLA inserts the collectives that the reference implements as
+SYNC_NODE_SLICES quantized all-gathers over TCP (src/nn/nn-network.cpp:537-569).
+
+Layer math (reference data flow, SURVEY.md §3.4):
+    x += attn(rms_norm(x)) ; x += ffn(rms_norm(x))
+with GQA attention over a pre-allocated per-lane KV cache, interleaved RoPE,
+and SiLU/GELU gated FFN. All reductions and attention math run in float32;
+matmuls run in the params' dtype (bf16 on TPU) with f32 accumulation.
+
+Optional ``emulate_q80_activations`` reproduces the reference's lossy
+activation quantization (cast to Q80 before each quantized matmul and at the
+TP sync boundary, src/llm.cpp:232-239,308-314) for numerical parity testing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..formats.model_file import HiddenAct
+from ..ops.activations import gelu, silu
+from ..ops.norm import rms_norm
+from ..ops.rope import apply_rope
+from .config import LlamaConfig
+
+
+class LlamaLayerParams(NamedTuple):
+    """Per-layer weights, stacked along a leading [n_layers] axis.
+
+    Matmul weights are stored [d_in, d_out] so that y = x @ W (the .m file
+    stores the transpose, [d_out, d_in]; the loader transposes once).
+    """
+
+    wq: jnp.ndarray  # [L, dim, dim]
+    wk: jnp.ndarray  # [L, dim, kv_dim]
+    wv: jnp.ndarray  # [L, dim, kv_dim]
+    wo: jnp.ndarray  # [L, dim, dim]
+    w1: jnp.ndarray  # [L, dim, hidden]   gate
+    w2: jnp.ndarray  # [L, hidden, dim]   down
+    w3: jnp.ndarray  # [L, dim, hidden]   up
+    rms_att: jnp.ndarray  # [L, dim]
+    rms_ffn: jnp.ndarray  # [L, dim]
+
+
+class LlamaParams(NamedTuple):
+    embedding: jnp.ndarray  # [vocab, dim]
+    layers: LlamaLayerParams
+    rms_final: jnp.ndarray  # [dim]
+    wcls: jnp.ndarray  # [dim, vocab]
+    rope_cos: jnp.ndarray  # [seq_len, head_size//2] f32
+    rope_sin: jnp.ndarray  # [seq_len, head_size//2] f32
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, S, n_kv_heads, head_size]
+    v: jnp.ndarray  # [L, B, S, n_kv_heads, head_size]
+
+
+def init_kv_cache(config: LlamaConfig, n_lanes: int, dtype=jnp.float32) -> KVCache:
+    shape = (config.n_layers, n_lanes, config.seq_len, config.n_kv_heads, config.head_size)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _qdq_q80(x: jnp.ndarray) -> jnp.ndarray:
+    """Quantize-dequantize through Q80 blocks of 32 along the last axis —
+    emulates the reference's F32->Q80 casts (src/nn/nn-quants.cpp:154-172):
+    fp16 block scale, round half away from zero."""
+    shape = x.shape
+    xf = x.astype(jnp.float32).reshape(*shape[:-1], shape[-1] // 32, 32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    d32 = amax / 127.0  # f32 scale used for the inverse (nn-quants.cpp:165-166)
+    inv = jnp.where(d32 != 0, 1.0 / jnp.where(d32 == 0, 1.0, d32), 0.0)
+    scaled = xf * inv
+    q = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)  # roundf semantics
+    q = jnp.clip(q, -128, 127)
+    d16 = d32.astype(jnp.float16).astype(jnp.float32)  # fp16 only for storage/dequant
+    return (q * d16).reshape(shape).astype(x.dtype)
+
+
+def llama_forward(
+    config: LlamaConfig,
+    params: LlamaParams,
+    tokens: jnp.ndarray,  # [B, T] int32
+    positions: jnp.ndarray,  # [B, T] int32 (per-lane positions; fixes reference defect (b))
+    cache: KVCache,
+    emulate_q80_activations: bool = False,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Returns (logits [B, T, vocab] float32, updated cache).
+
+    Works for prefill (T > 1) and decode (T = 1) alike; the KV cache is
+    per-lane (fixes reference defect (c) where all lanes shared one cache).
+    """
+    b, t = tokens.shape
+    h_cfg = config
+    n_heads, n_kv, hd = h_cfg.n_heads, h_cfg.n_kv_heads, h_cfg.head_size
+    eps = h_cfg.norm_epsilon
+    act_fn = silu if h_cfg.hidden_act == HiddenAct.SILU else gelu
+
+    maybe_qdq = _qdq_q80 if emulate_q80_activations else (lambda y: y)
+
+    x = params.embedding[tokens]  # [B, T, dim]
+    lane_idx = jnp.arange(b)[:, None]  # [B, 1]
+
+    # cache index validity: query at position p attends to cache slots s <= p
+    s_idx = jnp.arange(h_cfg.seq_len)  # [S]
+    attn_mask = s_idx[None, None, :] <= positions[:, :, None]  # [B, T, S]
+
+    def layer_step(x, layer_in):
+        lp, k_cache, v_cache = layer_in  # k/v: [B, S, n_kv, hd]
+        dtype = x.dtype
+
+        y = rms_norm(x, lp.rms_att, eps)
+        yq = maybe_qdq(y)
+        q = (yq @ lp.wq).reshape(b, t, n_heads, hd)
+        k = (yq @ lp.wk).reshape(b, t, n_kv, hd)
+        v = (yq @ lp.wv).reshape(b, t, n_kv, hd)
+
+        q = apply_rope(q, params.rope_cos, params.rope_sin, positions)
+        k = apply_rope(k, params.rope_cos, params.rope_sin, positions)
+
+        # KV append at per-lane positions (reference OP_SHIFT, scatter on TPU)
+        k_cache = k_cache.at[lane_idx, positions].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[lane_idx, positions].set(v.astype(v_cache.dtype))
+
+        # GQA attention in f32 (reference multiheadAtt_F32, nn-cpu-ops.cpp:749-784)
+        group = n_heads // n_kv
+        qf = q.astype(jnp.float32).reshape(b, t, n_kv, group, hd)
+        kf = k_cache.astype(jnp.float32)  # [B, S, n_kv, hd]
+        vf = v_cache.astype(jnp.float32)
+        scores = jnp.einsum("btkgh,bskh->btkgs", qf, kf) / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.where(attn_mask[:, :, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("btkgs,bskh->btkgh", probs, vf)
+        attn = attn.reshape(b, t, n_heads * hd).astype(dtype)
+
+        out = maybe_qdq(attn) @ lp.wo
+        x = x + maybe_qdq(out)  # sync-boundary cast (ZQ pipe) + merge_add
+
+        y = rms_norm(x, lp.rms_ffn, eps)
+        yq = maybe_qdq(y)
+        g = act_fn(yq @ lp.w1)
+        u = yq @ lp.w3
+        d = maybe_qdq(g * u) @ lp.w2
+        x = x + maybe_qdq(d)
+
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(layer_step, x, (params.layers, cache.k, cache.v))
+
+    y = rms_norm(x, params.rms_final, eps)
+    logits = (maybe_qdq(y) @ params.wcls).astype(jnp.float32)  # [B, T, vocab]
+    return logits, KVCache(k=new_k, v=new_v)

@@ -1,0 +1,177 @@
+"""Weight loading: .m file -> LlamaParams pytree.
+
+Replaces the reference's split-and-ship weight path (src/llm.cpp:447-483,
+src/nn/nn-network.cpp:824-901): instead of slicing shards on the root and
+streaming them to workers over TCP, tensors are dequantized host-side and
+handed to jax.device_put with sharding annotations — PJRT does the
+placement/transfer that NnRootWeightLoader did by hand.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..formats.model_file import ModelHeader, iter_model_tensors
+from ..ops.rope import build_rope_cache
+from ..quants.codec import FloatType, dequantize_q40, dequantize_q80
+from .config import LlamaConfig
+from .llama import LlamaLayerParams, LlamaParams
+
+
+def _decode_tensor(raw: np.ndarray, float_type: int, shape: tuple[int, int]) -> np.ndarray:
+    if float_type == FloatType.F32:
+        x = raw.view("<f4").astype(np.float32)
+    elif float_type == FloatType.F16:
+        x = raw.view("<f2").astype(np.float32)
+    elif float_type == FloatType.Q40:
+        x = dequantize_q40(raw)
+    elif float_type == FloatType.Q80:
+        x = dequantize_q80(raw)
+    else:
+        raise ValueError(f"unsupported float type {float_type}")
+    return np.ascontiguousarray(x.reshape(shape))
+
+
+_TENSOR_NAME_MAP = {
+    "block_matmul_q": "wq",
+    "block_matmul_k": "wk",
+    "block_matmul_v": "wv",
+    "block_matmul_wo": "wo",
+    "block_matmul_w1": "w1",
+    "block_matmul_w2": "w2",
+    "block_matmul_w3": "w3",
+    "block_rms_norm_0": "rms_att",
+    "block_rms_norm_1": "rms_ffn",
+}
+
+
+def read_m_tensors(path: str, header: ModelHeader) -> dict:
+    """Read a .m file as dequantized f32 arrays in file orientation
+    ([d_out, d_in] matmuls): embedding, rms_final, wcls plus per-layer lists
+    wq,wk,wv,wo,w1,w2,w3,rms_att,rms_ffn (order: src/llm.cpp:447-483)."""
+    config = LlamaConfig.from_header(header)
+    w: dict = {k: [None] * config.n_layers for k in _TENSOR_NAME_MAP.values()}
+    for spec, raw in iter_model_tensors(path, header):
+        x = _decode_tensor(raw, spec.float_type, spec.shape)
+        if spec.name == "embedding":
+            w["embedding"] = x
+        elif spec.name == "final_rms_norm":
+            w["rms_final"] = x.reshape(-1)
+        elif spec.name == "final_matmul_logits":
+            w["wcls"] = x
+        else:
+            key = _TENSOR_NAME_MAP[spec.name]
+            w[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
+    return w
+
+
+def load_params_from_m(
+    path: str,
+    header: ModelHeader,
+    dtype=jnp.bfloat16,
+    device_put_fn=None,
+) -> tuple[LlamaConfig, LlamaParams]:
+    """Load and dequantize all tensors; matmul weights are transposed to
+    [d_in, d_out] (the .m stores [d_out, d_in], src/llm.cpp:447-483) and
+    per-layer tensors stacked along a leading [n_layers] axis.
+
+    ``device_put_fn(name, np_array) -> jax.Array`` lets callers control
+    placement/sharding; defaults to plain jnp.asarray.
+    """
+    config = LlamaConfig.from_header(header)
+    put = device_put_fn or (lambda name, x: jnp.asarray(x))
+
+    raw_w = read_m_tensors(path, header)
+    embedding = raw_w["embedding"]
+    rms_final = raw_w["rms_final"]
+    wcls = raw_w["wcls"].T  # -> [dim, vocab]
+    stacked = {}
+    for key in _TENSOR_NAME_MAP.values():
+        mats = raw_w[key]
+        if key.startswith("rms"):
+            stacked[key] = np.stack(mats)
+        else:
+            stacked[key] = np.stack([m.T for m in mats])  # -> [L, d_in, d_out]
+
+    np_dtype = np.dtype(jnp.dtype(dtype).name) if jnp.dtype(dtype) != jnp.bfloat16 else None
+
+    def cast(x: np.ndarray) -> np.ndarray:
+        # bf16 has no numpy dtype; jnp.asarray handles the cast at put time
+        return x if np_dtype is None else x.astype(np_dtype)
+    cos, sin = build_rope_cache(
+        config.seq_len,
+        config.head_size,
+        config.rope_theta,
+        config.rope_scaling_factor,
+        config.rope_scaling_low_freq_factor,
+        config.rope_scaling_high_freq_factor,
+        config.rope_scaling_orig_max_seq_len,
+    )
+
+    layers = LlamaLayerParams(
+        wq=put("wq", cast(stacked["wq"])).astype(dtype),
+        wk=put("wk", cast(stacked["wk"])).astype(dtype),
+        wv=put("wv", cast(stacked["wv"])).astype(dtype),
+        wo=put("wo", cast(stacked["wo"])).astype(dtype),
+        w1=put("w1", cast(stacked["w1"])).astype(dtype),
+        w2=put("w2", cast(stacked["w2"])).astype(dtype),
+        w3=put("w3", cast(stacked["w3"])).astype(dtype),
+        rms_att=put("rms_att", stacked["rms_att"]).astype(jnp.float32),
+        rms_ffn=put("rms_ffn", stacked["rms_ffn"]).astype(jnp.float32),
+    )
+    params = LlamaParams(
+        embedding=put("embedding", cast(embedding)).astype(dtype),
+        layers=layers,
+        rms_final=put("rms_final", rms_final).astype(jnp.float32),
+        wcls=put("wcls", cast(wcls)).astype(dtype),
+        rope_cos=put("rope_cos", cos),
+        rope_sin=put("rope_sin", sin),
+    )
+    return config, params
+
+
+def params_from_random(config: LlamaConfig, seed: int = 0, dtype=jnp.bfloat16, scale: float = 0.02) -> LlamaParams:
+    """Random-weight params with the right shapes — used by benchmarks so that
+    multi-GB models need not exist on disk."""
+    rng = np.random.default_rng(seed)
+    L, dim, hidden, kv_dim, vocab = (
+        config.n_layers,
+        config.dim,
+        config.hidden_dim,
+        config.kv_dim,
+        config.vocab_size,
+    )
+
+    def r(*shape):
+        return jnp.asarray(rng.standard_normal(shape, dtype=np.float32) * scale, dtype=dtype)
+
+    cos, sin = build_rope_cache(
+        config.seq_len,
+        config.head_size,
+        config.rope_theta,
+        config.rope_scaling_factor,
+        config.rope_scaling_low_freq_factor,
+        config.rope_scaling_high_freq_factor,
+        config.rope_scaling_orig_max_seq_len,
+    )
+    layers = LlamaLayerParams(
+        wq=r(L, dim, dim),
+        wk=r(L, dim, kv_dim),
+        wv=r(L, dim, kv_dim),
+        wo=r(L, dim, dim),
+        w1=r(L, dim, hidden),
+        w2=r(L, hidden, dim),
+        w3=r(L, dim, hidden),
+        rms_att=jnp.ones((L, dim), jnp.float32),
+        rms_ffn=jnp.ones((L, dim), jnp.float32),
+    )
+    return LlamaParams(
+        embedding=r(vocab, dim),
+        layers=layers,
+        rms_final=jnp.ones((dim,), jnp.float32),
+        wcls=r(dim, vocab),
+        rope_cos=jnp.asarray(cos),
+        rope_sin=jnp.asarray(sin),
+    )

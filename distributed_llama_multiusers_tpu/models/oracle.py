@@ -1,0 +1,144 @@
+"""Numpy oracle: the reference engine's math, step for step.
+
+This plays the role the CPU backend plays in the reference's own test
+strategy (SURVEY.md §7 stage 2): an independent, easily-auditable
+implementation used to check the XLA path for token parity. It follows the
+single-node graph of src/llm.cpp:126-438 literally, including the lossy
+activation casts:
+
+    embedding -> per layer [ rms -> Q80 cast -> q/k/v matmul -> rope ->
+    kv append -> attention -> Q80 cast -> wo matmul -> Q80 cast (ZQ) ->
+    residual add ] [ rms -> Q80 cast -> w1/w3 -> silu*mul -> Q80 cast ->
+    w2 -> Q80 cast (ZQ) -> residual add ] -> final rms -> wcls
+
+Weights come in as dequantized f32 (the Q40 noise is already baked in by the
+file codecs). Everything is float32.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..formats.model_file import HiddenAct
+from ..quants.codec import quantize_dequantize_q80
+from .config import LlamaConfig
+
+
+def _qdq80(x: np.ndarray) -> np.ndarray:
+    return quantize_dequantize_q80(x, mode="runtime").astype(np.float32)
+
+
+class OracleLlama:
+    """Single-stream (batch=1) stateful decoder with a KV cache."""
+
+    def __init__(self, config: LlamaConfig, weights: dict, emulate_q80: bool = True):
+        """``weights``: dict with f32 numpy arrays in .m orientation
+        ([d_out, d_in] matmuls): embedding [vocab, dim], per-layer lists
+        wq,wk,wv,wo,w1,w2,w3,rms_att,rms_ffn, plus rms_final, wcls."""
+        self.c = config
+        self.w = weights
+        self.emulate_q80 = emulate_q80
+        S = config.seq_len
+        self.k_cache = np.zeros((config.n_layers, S, config.n_kv_heads, config.head_size), np.float32)
+        self.v_cache = np.zeros_like(self.k_cache)
+        from ..ops.rope import build_rope_cache
+
+        self.cos, self.sin = build_rope_cache(
+            S,
+            config.head_size,
+            config.rope_theta,
+            config.rope_scaling_factor,
+            config.rope_scaling_low_freq_factor,
+            config.rope_scaling_high_freq_factor,
+            config.rope_scaling_orig_max_seq_len,
+        )
+
+    def reset(self):
+        self.k_cache[:] = 0
+        self.v_cache[:] = 0
+
+    def _rms(self, x: np.ndarray, w: np.ndarray) -> np.ndarray:
+        inv = 1.0 / np.sqrt(np.mean(x.astype(np.float32) ** 2) + self.c.norm_epsilon)
+        return (x * inv * w).astype(np.float32)
+
+    def _rope(self, x: np.ndarray, pos: int) -> np.ndarray:
+        # x: [n_heads_x, head_size], interleaved pairs
+        h, d = x.shape
+        c = self.cos[pos]
+        s = self.sin[pos]
+        x = x.reshape(h, d // 2, 2).copy()
+        x0 = x[:, :, 0].copy()
+        x1 = x[:, :, 1].copy()
+        x[:, :, 0] = x0 * c - x1 * s
+        x[:, :, 1] = x0 * s + x1 * c
+        return x.reshape(h, d)
+
+    def forward(self, token: int, pos: int) -> np.ndarray:
+        """One decode step; returns logits [vocab] float32."""
+        c = self.c
+        qdq = _qdq80 if self.emulate_q80 else (lambda v: v)
+        n_kv, hd, group = c.n_kv_heads, c.head_size, c.n_heads // c.n_kv_heads
+
+        x = self.w["embedding"][token].astype(np.float32).copy()
+        for l in range(c.n_layers):
+            y = self._rms(x, self.w["rms_att"][l])
+            yq = qdq(y)
+            q = (self.w["wq"][l] @ yq).reshape(c.n_heads, hd)
+            k = (self.w["wk"][l] @ yq).reshape(n_kv, hd)
+            v = (self.w["wv"][l] @ yq).reshape(n_kv, hd)
+            q = self._rope(q, pos)
+            k = self._rope(k, pos)
+            self.k_cache[l, pos] = k
+            self.v_cache[l, pos] = v
+
+            # attention over 0..pos (nn-cpu-ops.cpp:749-784)
+            att_out = np.empty((c.n_heads, hd), np.float32)
+            for h in range(c.n_heads):
+                kv_h = h // group
+                keys = self.k_cache[l, : pos + 1, kv_h]  # [pos+1, hd]
+                vals = self.v_cache[l, : pos + 1, kv_h]
+                scores = keys @ q[h] / np.sqrt(np.float32(hd))
+                scores = scores - scores.max()
+                e = np.exp(scores)
+                p = e / e.sum()
+                att_out[h] = p @ vals
+            att_flat = att_out.reshape(-1)
+            out = self.w["wo"][l] @ qdq(att_flat)
+            x = x + qdq(out)
+
+            y = self._rms(x, self.w["rms_ffn"][l])
+            yq = qdq(y)
+            g = self.w["w1"][l] @ yq
+            u = self.w["w3"][l] @ yq
+            if c.hidden_act == HiddenAct.SILU:
+                g = g / (1.0 + np.exp(-g))
+            else:
+                g = 0.5 * g * (1.0 + np.tanh(0.797884560802865 * g * (1.0 + 0.044715 * g * g)))
+            d = self.w["w2"][l] @ qdq(g * u)
+            x = x + qdq(d)
+
+        y = self._rms(x, self.w["rms_final"])
+        return (self.w["wcls"] @ qdq(y)).astype(np.float32)
+
+    def generate_greedy(self, prompt_tokens: list[int], n_steps: int) -> list[int]:
+        """Prefill the prompt token-by-token then greedy-decode n_steps."""
+        self.reset()
+        logits = None
+        for i, t in enumerate(prompt_tokens):
+            logits = self.forward(t, i)
+        out = []
+        pos = len(prompt_tokens)
+        cur = int(np.argmax(logits))
+        for _ in range(n_steps):
+            out.append(cur)
+            logits = self.forward(cur, pos)
+            pos += 1
+            cur = int(np.argmax(logits))
+        return out
+
+
+def oracle_weights_from_m(path: str, header) -> dict:
+    """Load .m tensors as dequantized f32 in file orientation."""
+    from .loader import read_m_tensors
+
+    return read_m_tensors(path, header)

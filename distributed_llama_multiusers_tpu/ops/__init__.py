@@ -1,0 +1,3 @@
+from .norm import rms_norm
+from .rope import build_rope_cache, apply_rope
+from .activations import silu, gelu

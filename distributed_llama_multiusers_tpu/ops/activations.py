@@ -1,0 +1,16 @@
+"""Hidden activations (src/nn/nn-cpu-ops.cpp:445-491). Computed in f32."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    return (xf / (1.0 + jnp.exp(-xf))).astype(x.dtype)
+
+
+def gelu(x: jnp.ndarray) -> jnp.ndarray:
+    # tanh approximation, matching gelu_F32 (nn-cpu-ops.cpp:445-451)
+    xf = x.astype(jnp.float32)
+    return (0.5 * xf * (1.0 + jnp.tanh(0.797884560802865 * xf * (1.0 + 0.044715 * xf * xf)))).astype(x.dtype)

@@ -1,0 +1,19 @@
+"""RMS norm.
+
+Matches the reference's two-op split semantics (OP_INV_RMS computes
+1/sqrt(mean(x^2)+eps) per row in f32, OP_RMS_NORM multiplies by the weight;
+src/nn/nn-cpu-ops.cpp:105-180) as a single fused op — XLA fuses the reduction
+and the scale into one VPU pass anyway.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """x: [..., dim]; weight: [dim]. Reduction in float32 regardless of x dtype."""
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * inv * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,4 @@
+from .tokenizer import Tokenizer
+from .chat import ChatTemplateGenerator, ChatItem, GeneratedChat, TokenizerChatStops, TemplateType
+from .eos import EosDetector, EosResult
+from .sampler import Sampler

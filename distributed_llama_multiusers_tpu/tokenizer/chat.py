@@ -1,0 +1,100 @@
+"""Chat template rendering + chat stop strings.
+
+Port of ChatTemplateGenerator / TokenizerChatStops (src/tokenizer.cpp:512-612):
+hard-coded renderers for llama2 / llama3 / deepSeek3, auto-detected from the
+Jinja template string stored in the tokenizer file.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import IntEnum
+
+from .tokenizer import Tokenizer
+
+
+class TemplateType(IntEnum):
+    UNKNOWN = 0
+    LLAMA2 = 1
+    LLAMA3 = 2
+    DEEP_SEEK3 = 3
+
+
+@dataclass
+class ChatItem:
+    role: str
+    message: str
+
+
+@dataclass
+class GeneratedChat:
+    content: str
+    public_prompt: str | None  # deepSeek3 exposes its injected "<think>\n" tail
+
+
+class TokenizerChatStops:
+    """Stop strings = the pieces of the tokenizer's EOS tokens
+    (src/tokenizer.cpp:512-525)."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self.stops: list[str] = [
+            tokenizer.vocab[t].decode("utf-8", errors="replace") for t in tokenizer.eos_token_ids
+        ]
+        self.max_stop_length = max((len(s) for s in self.stops), default=0)
+
+
+class ChatTemplateGenerator:
+    def __init__(self, template_type: TemplateType, chat_template: str | None, eos: str):
+        if template_type == TemplateType.UNKNOWN:
+            if chat_template is None:
+                raise ValueError("The tokenizer does not include chat template")
+            if "[INST]" in chat_template:
+                template_type = TemplateType.LLAMA2
+            elif "<|start_header_id|>" in chat_template:
+                template_type = TemplateType.LLAMA3
+            elif "<｜Assistant｜>" in chat_template:
+                template_type = TemplateType.DEEP_SEEK3
+            else:
+                raise ValueError("Not supported chat template")
+        self.type = template_type
+        self.eos = eos
+
+    def generate(self, items: list[ChatItem], append_generation_prompt: bool) -> GeneratedChat:
+        buf = []
+        public_prompt_size = 0
+        eos = self.eos
+        if self.type == TemplateType.LLAMA2:
+            i = 0
+            if len(items) >= 2 and items[0].role == "system" and items[1].role == "user":
+                buf.append(
+                    "[INST] <<SYS>>\n" + items[0].message + "\n<</SYS>>\n\n" + items[1].message + " [/INST]" + eos
+                )
+                i = 2
+            for item in items[i:]:
+                if item.role == "assistant":
+                    buf.append(item.message + eos)
+                elif item.role == "user":
+                    buf.append("[INST] " + item.message + " [/INST]" + eos)
+        elif self.type == TemplateType.LLAMA3:
+            for item in items:
+                buf.append(
+                    "<|start_header_id|>" + item.role + "<|end_header_id|>\n\n" + item.message + eos
+                )
+            if append_generation_prompt:
+                buf.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        elif self.type == TemplateType.DEEP_SEEK3:
+            i = 0
+            if items and items[0].role == "system":
+                buf.append(items[0].message)
+                i = 1
+            for item in items[i:]:
+                if item.role == "user":
+                    buf.append("<｜User｜>" + item.message)
+                elif item.role == "assistant":
+                    buf.append("<｜Assistant｜>" + item.message)
+            if append_generation_prompt:
+                buf.append("<｜Assistant｜><think>\n")
+                public_prompt_size = 8
+        content = "".join(buf)
+        public_prompt = content[-public_prompt_size:] if public_prompt_size > 0 else None
+        return GeneratedChat(content=content, public_prompt=public_prompt)

@@ -1,0 +1,196 @@
+"""BPE tokenizer over the `.t` format, with streaming UTF-8 decode.
+
+Re-design of src/tokenizer.cpp:42-380. Same observable behavior:
+
+- vocab is split into regular / special at ``bos_id`` (the reference's
+  "unstable assumption", src/tokenizer.cpp:137-139)
+- encode: greedy longest-special-token scan, byte-accumulation seeding, then
+  iterative best-score pair merging (src/tokenizer.cpp:301-368)
+- decode: per-token streaming with UTF-8 validation + recovery emitting
+  U+FFFD, holding back incomplete trailing sequences (src/tokenizer.cpp:214-299)
+"""
+
+from __future__ import annotations
+
+from ..formats.tokenizer_file import TokenizerData, load_tokenizer_file
+
+_FFFD = b"\xef\xbf\xbd"
+
+
+class Tokenizer:
+    def __init__(self, data: TokenizerData | str):
+        if isinstance(data, str):
+            data = load_tokenizer_file(data)
+        self.data = data
+        self.vocab: list[bytes] = data.vocab
+        self.scores: list[float] = data.scores
+        self.bos_id: int = data.bos_id
+        self.eos_token_ids: list[int] = list(data.eos_token_ids)
+        self.chat_template: str | None = data.chat_template
+        self.vocab_size: int = data.vocab_size
+
+        self.regular_vocab_size = self.bos_id
+        self.special_vocab_size = self.vocab_size - self.regular_vocab_size
+        # token string -> id for the regular vocab (replaces the reference's
+        # qsort+bsearch TokenIndex table, src/tokenizer.cpp:141-146)
+        self._regular: dict[bytes, int] = {}
+        for i in range(self.regular_vocab_size):
+            self._regular.setdefault(self.vocab[i], i)
+        # special tokens in id order (the reference scans them in id order and
+        # takes the first prefix match, src/tokenizer.cpp:186-194)
+        self._specials: list[tuple[int, bytes]] = [
+            (i, self.vocab[i]) for i in range(self.regular_vocab_size, self.vocab_size)
+        ]
+        self._decode_pending = b""  # held-back bytes of an incomplete UTF-8 seq
+
+    # ---- encode -----------------------------------------------------------
+
+    def encode(
+        self,
+        text: str | bytes,
+        add_bos: bool = True,
+        add_special_tokens: bool = True,
+    ) -> list[int]:
+        if isinstance(text, str):
+            text = text.encode("utf-8")
+        tokens: list[int] = []
+        if add_bos:
+            tokens.append(self.bos_id)
+
+        buf = b""
+        i = 0
+        n = len(text)
+        while i < n:
+            if add_special_tokens:
+                special = self._find_special_at(text, i)
+                if special is not None:
+                    if buf:
+                        raise ValueError(f"untokenizable bytes before special token: {buf!r}")
+                    tokens.append(special)
+                    i += len(self.vocab[special])
+                    continue
+            buf += text[i : i + 1]
+            i += 1
+            tid = self._regular.get(buf)
+            if tid is not None:
+                tokens.append(tid)
+                buf = b""
+        if buf:
+            # the reference asserts here (src/tokenizer.cpp:337)
+            raise ValueError(f"untokenizable trailing bytes: {buf!r}")
+
+        # iterative best-score merge (src/tokenizer.cpp:340-368)
+        while True:
+            best_score = -1e10
+            best_id = -1
+            best_idx = -1
+            for j in range(len(tokens) - 1):
+                a, b = tokens[j], tokens[j + 1]
+                if a >= self.vocab_size or b >= self.vocab_size:
+                    continue
+                merged = self._regular.get(self.vocab[a] + self.vocab[b])
+                if merged is not None and self.scores[merged] > best_score:
+                    best_score = self.scores[merged]
+                    best_id = merged
+                    best_idx = j
+            if best_idx == -1:
+                break
+            tokens[best_idx : best_idx + 2] = [best_id]
+        return tokens
+
+    def _find_special_at(self, text: bytes, pos: int) -> int | None:
+        for tid, piece in self._specials:
+            if text.startswith(piece, pos):
+                return tid
+        return None
+
+    # ---- decode -----------------------------------------------------------
+
+    def is_eos(self, token: int) -> bool:
+        return token in self.eos_token_ids
+
+    def reset_decoder(self) -> None:
+        self._decode_pending = b""
+
+    def decode(self, token: int) -> str | None:
+        """Streaming decode of one token; returns the printable delta or None.
+
+        Mirrors Tokenizer::decode (src/tokenizer.cpp:281-299): BOS yields
+        nothing; EOS flushes any held-back bytes; other tokens append their
+        piece and emit the longest valid UTF-8 prefix.
+        """
+        if token == self.bos_id:
+            return None
+        if self.is_eos(token):
+            if self._decode_pending:
+                out = self._decode_pending.decode("utf-8", errors="replace")
+                self._decode_pending = b""
+                return out
+            return None
+        piece = self.vocab[token]
+        return self._detok_utf8(self._decode_pending + piece)
+
+    def decode_full(self, tokens: list[int]) -> str:
+        """Non-streaming convenience: decode a whole sequence."""
+        self.reset_decoder()
+        parts = [self.decode(t) for t in tokens]
+        pending = self._decode_pending.decode("utf-8", errors="replace")
+        self._decode_pending = b""
+        return "".join(p for p in parts if p) + pending
+
+    def _detok_utf8(self, data: bytes) -> str | None:
+        """Port of detokUtf8 (src/tokenizer.cpp:214-279): emit the valid
+        prefix, collapse runs of invalid bytes into a single U+FFFD, hold back
+        an incomplete trailing sequence for the next call."""
+        out = bytearray()
+        i = 0
+        n = len(data)
+        checkpoint_out = 0  # bytes of `out` confirmed (ends on char boundary)
+        checkpoint_src = 0
+        expect = 0
+        while i < n:
+            c = data[i]
+            need_recovery = False
+            if expect:
+                if (c & 0xC0) == 0x80:
+                    out.append(c)
+                    i += 1
+                    expect -= 1
+                else:
+                    need_recovery = True
+            elif c <= 0x7F:
+                out.append(c)
+                i += 1
+            elif 0xC0 <= c <= 0xDF:
+                out.append(c)
+                i += 1
+                expect = 1
+            elif 0xE0 <= c <= 0xEF:
+                out.append(c)
+                i += 1
+                expect = 2
+            elif 0xF0 <= c <= 0xF7:
+                out.append(c)
+                i += 1
+                expect = 3
+            else:
+                need_recovery = True
+
+            if not need_recovery:
+                if expect == 0:
+                    checkpoint_out = len(out)
+                    checkpoint_src = i
+            else:
+                if expect:
+                    expect = 0
+                else:
+                    i += 1
+                del out[checkpoint_out:]
+                out += _FFFD
+        if i > checkpoint_src:
+            self._decode_pending = data[checkpoint_src:]
+        else:
+            self._decode_pending = b""
+        if checkpoint_out > 0:
+            return bytes(out[:checkpoint_out]).decode("utf-8", errors="replace")
+        return None

@@ -1,0 +1,44 @@
+"""Test/dev-environment helpers.
+
+Multi-chip behavior is validated on a virtual CPU device mesh, the TPU
+analogue of the reference's fake-synchronizer + local-process-cluster test
+strategy (src/nn/nn-executor.cpp:6-8, examples/n-workers.sh): the same GSPMD
+partitioner and collectives run, just over host devices.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def force_cpu_mesh(n_devices: int = 8) -> None:
+    """Force JAX onto `n_devices` virtual CPU devices. Call BEFORE any jax
+    backend is initialized.
+
+    Two things are needed in this environment:
+    1. xla_force_host_platform_device_count so one host looks like a mesh.
+    2. Dropping any pre-registered TPU PJRT plugin (this box's sitecustomize
+       registers one at interpreter start whose init dials a network tunnel —
+       even under JAX_PLATFORMS=cpu, backend discovery would block on it).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        if xla_bridge._default_backend is not None:  # pragma: no cover
+            raise RuntimeError("force_cpu_mesh() must run before JAX backends initialize")
+        # jax may have been imported (and read JAX_PLATFORMS) before us
+        jax.config.update("jax_platforms", "cpu")
+        for name in list(xla_bridge._backend_factories):
+            if name != "cpu":
+                del xla_bridge._backend_factories[name]
+    except ImportError:
+        pass

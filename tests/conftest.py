@@ -9,10 +9,9 @@ collectives run through the same GSPMD paths they would take over ICI.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+from distributed_llama_multiusers_tpu.utils.testing import force_cpu_mesh
+
+force_cpu_mesh(n_devices=8)
 
 import pytest  # noqa: E402
 
