@@ -1,0 +1,81 @@
+"""Benchmark: batched decode throughput of the flagship model on real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: single-stream (batch=1) decode tokens/sec for a Llama-3.2-1B-shaped
+bf16 model with a 2048-token KV cache, measured over 64 steps after warmup.
+
+vs_baseline: ratio against the reference's best published single-device
+number — Llama 2 7B on 1x RPi 4B at 1312.50 ms/token = 0.762 tok/s
+(report.pdf Fig. 3, BASELINE.md). Caveat: model sizes differ (1B here vs 7B
+there); the 7B/8-node figure (588 ms/token, 1.70 tok/s) is the distributed
+headline this framework targets at scale. Later rounds calibrate against the
+reference built from source on identical synthetic models.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_SINGLE_DEVICE_TOK_S = 1000.0 / 1312.50  # report.pdf Fig. 3
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship_config
+    from distributed_llama_multiusers_tpu.models import (
+        init_kv_cache,
+        llama_forward,
+        params_from_random,
+    )
+
+    small = os.environ.get("GRAFT_SMALL") == "1"
+    config = _flagship_config(small=small)
+    params = params_from_random(config, seed=0, dtype=jnp.bfloat16)
+    cache = init_kv_cache(config, n_lanes=1, dtype=jnp.bfloat16)
+
+    from functools import partial
+
+    # donate the cache so XLA updates it in place instead of copying ~64 MB
+    # of KV per step
+    @partial(jax.jit, donate_argnums=(3,))
+    def decode_step(params, tokens, positions, cache):
+        return llama_forward(config, params, tokens, positions, cache)
+
+    tok = jnp.zeros((1, 1), jnp.int32)
+
+    # warmup / compile
+    logits, cache = decode_step(params, tok, jnp.array([[0]], jnp.int32), cache)
+    logits.block_until_ready()
+
+    n_steps = 16 if small else 64
+    start_pos = 1
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        pos = jnp.array([[start_pos + i]], jnp.int32)
+        logits, cache = decode_step(params, tok, pos, cache)
+    logits.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tok_s = n_steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "llama32_1b_bf16_decode_tok_s",
+                "value": round(tok_s, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / REFERENCE_SINGLE_DEVICE_TOK_S, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

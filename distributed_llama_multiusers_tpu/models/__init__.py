@@ -1,3 +1,3 @@
 from .config import LlamaConfig
-from .llama import LlamaParams, llama_forward, init_kv_cache
+from .llama import LlamaParams, llama_forward, llama_forward_train, init_kv_cache
 from .loader import load_params_from_m, params_from_random

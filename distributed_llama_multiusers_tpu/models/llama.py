@@ -71,19 +71,11 @@ def init_kv_cache(config: LlamaConfig, n_lanes: int, dtype=jnp.float32) -> KVCac
 
 
 def _qdq_q80(x: jnp.ndarray) -> jnp.ndarray:
-    """Quantize-dequantize through Q80 blocks of 32 along the last axis —
-    emulates the reference's F32->Q80 casts (src/nn/nn-quants.cpp:154-172):
-    fp16 block scale, round half away from zero."""
-    shape = x.shape
-    xf = x.astype(jnp.float32).reshape(*shape[:-1], shape[-1] // 32, 32)
-    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-    d32 = amax / 127.0  # f32 scale used for the inverse (nn-quants.cpp:165-166)
-    inv = jnp.where(d32 != 0, 1.0 / jnp.where(d32 == 0, 1.0, d32), 0.0)
-    scaled = xf * inv
-    q = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)  # roundf semantics
-    q = jnp.clip(q, -128, 127)
-    d16 = d32.astype(jnp.float16).astype(jnp.float32)  # fp16 only for storage/dequant
-    return (q * d16).reshape(shape).astype(x.dtype)
+    """Quantize-dequantize through Q80 blocks — emulates the reference's
+    F32->Q80 casts (src/nn/nn-quants.cpp:154-172) via the shared JAX codec."""
+    from ..quants.jax_codec import qdq_q80
+
+    return qdq_q80(x, mode="runtime")
 
 
 def llama_forward(
@@ -159,3 +151,48 @@ def llama_forward(
     y = rms_norm(x, params.rms_final, eps)
     logits = (maybe_qdq(y) @ params.wcls).astype(jnp.float32)  # [B, T, vocab]
     return logits, KVCache(k=new_k, v=new_v)
+
+
+def llama_forward_train(
+    config: LlamaConfig,
+    params: LlamaParams,
+    tokens: jnp.ndarray,  # [B, T] int32
+) -> jnp.ndarray:
+    """Cache-free causal forward over a full sequence — the training-mode twin
+    of ``llama_forward`` (the reference is inference-only; training support is
+    a capability extension, same layer math). Returns logits [B, T, vocab]."""
+    b, t = tokens.shape
+    n_heads, n_kv, hd = config.n_heads, config.n_kv_heads, config.head_size
+    eps = config.norm_epsilon
+    act_fn = silu if config.hidden_act == HiddenAct.SILU else gelu
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+    causal = jnp.tril(jnp.ones((t, t), bool))
+
+    x = params.embedding[tokens]
+
+    def layer_step(x, lp):
+        dtype = x.dtype
+        y = rms_norm(x, lp.rms_att, eps)
+        q = (y @ lp.wq).reshape(b, t, n_heads, hd)
+        k = (y @ lp.wk).reshape(b, t, n_kv, hd)
+        v = (y @ lp.wv).reshape(b, t, n_kv, hd)
+        q = apply_rope(q, params.rope_cos, params.rope_sin, positions)
+        k = apply_rope(k, params.rope_cos, params.rope_sin, positions)
+
+        group = n_heads // n_kv
+        qf = q.astype(jnp.float32).reshape(b, t, n_kv, group, hd)
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+        scores = jnp.einsum("btkgh,bskh->btkgs", qf, kf) / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.where(causal[:, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("btkgs,bskh->btkgh", probs, vf).reshape(b, t, n_heads * hd)
+        x = x + (attn.astype(dtype) @ lp.wo)
+
+        y = rms_norm(x, lp.rms_ffn, eps)
+        x = x + (act_fn(y @ lp.w1) * (y @ lp.w3)) @ lp.w2
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params.layers)
+    y = rms_norm(x, params.rms_final, eps)
+    return (y @ params.wcls).astype(jnp.float32)

@@ -1,0 +1,3 @@
+from .mesh import make_mesh, MeshPlan, validate_mesh_for_config
+from .sharding import param_shardings, cache_shardings, data_shardings
+from .collectives import q80_all_gather

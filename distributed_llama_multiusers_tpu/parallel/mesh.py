@@ -1,0 +1,67 @@
+"""Device-mesh construction and validity checks.
+
+The reference distributes over 2^n TCP "nodes" in a flat ring and checks
+nNodes <= nKvHeads before starting (src/app.cpp:237-240, README.md:44-46).
+Here a node is a TPU chip in a `jax.sharding.Mesh` with named axes:
+
+    dp — data/batch (request lanes)         [reference: none — single replica]
+    tp — tensor parallel (heads / ffn dim)  [reference: the core strategy]
+    sp — sequence parallel (KV cache S)     [reference: absent, §5.7]
+
+All collectives ride ICI via GSPMD; the bootstrap/config/weight-shipping
+protocol of nn-network.cpp collapses into device_put with shardings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..models.config import LlamaConfig
+
+AXES = ("dp", "tp", "sp")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.tp * self.sp
+
+
+def make_mesh(plan: MeshPlan | None = None, devices=None) -> Mesh:
+    """Build a Mesh with axes (dp, tp, sp). With no plan, all devices go to tp
+    (the reference's pure-TP layout)."""
+    if devices is None:
+        devices = jax.devices()
+    if plan is None:
+        plan = MeshPlan(tp=len(devices))
+    if plan.n_devices > len(devices):
+        raise ValueError(f"mesh plan needs {plan.n_devices} devices, have {len(devices)}")
+    devs = np.asarray(devices[: plan.n_devices]).reshape(plan.dp, plan.tp, plan.sp)
+    return Mesh(devs, AXES)
+
+
+def validate_mesh_for_config(config: LlamaConfig, plan: MeshPlan) -> None:
+    """TP validity rules carried over from the reference (src/app.cpp:237,
+    slicer asserts nn-core.cpp:198-266) plus SP divisibility."""
+    tp, sp = plan.tp, plan.sp
+    if tp > config.n_kv_heads:
+        raise ValueError(f"tp={tp} exceeds n_kv_heads={config.n_kv_heads}")
+    if config.n_kv_heads % tp != 0:
+        raise ValueError(f"n_kv_heads={config.n_kv_heads} not divisible by tp={tp}")
+    if config.n_heads % tp != 0:
+        raise ValueError(f"n_heads={config.n_heads} not divisible by tp={tp}")
+    if config.dim % tp != 0 or config.hidden_dim % tp != 0:
+        raise ValueError("dim/hidden_dim not divisible by tp")
+    if config.vocab_size % tp != 0:
+        raise ValueError("vocab_size not divisible by tp")
+    if config.seq_len % sp != 0:
+        raise ValueError(f"seq_len={config.seq_len} not divisible by sp={sp}")

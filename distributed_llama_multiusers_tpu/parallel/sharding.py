@@ -1,0 +1,82 @@
+"""Sharding specs: the reference's TP slicing math as GSPMD annotations.
+
+Mapping from the reference slicers (src/nn/nn-core.cpp:198-266):
+
+    sliceRowMatmul (split output dim)  -> shard last axis of [L, d_in, d_out]
+        applies to wq, wk, wv, w1, w3, wcls
+    sliceColMatmul (split input dim)   -> shard middle axis of [L, d_in, d_out]
+        applies to wo, w2
+    sliceKvCache (split kvDim)         -> shard n_kv_heads axis of the cache
+    sliceMultiHeadAtt (split heads)    -> implied by the same tp axis
+    ZQ all-gather + merge_add          -> XLA inserts reduce-scatter/all-reduce
+                                          at the wo/w2 matmul outputs
+
+The row->col pairing means activations stay sharded through attention and the
+FFN with exactly one collective per half-layer — the same schedule the
+reference realizes manually with its quantized TCP all-gather
+(SYNC_NODE_SLICES, src/nn/nn-network.cpp:537-569), but on ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import KVCache, LlamaLayerParams, LlamaParams
+
+
+def param_shardings(mesh: Mesh) -> LlamaParams:
+    """A LlamaParams-shaped pytree of NamedShardings."""
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layers = LlamaLayerParams(
+        wq=ns(None, None, "tp"),
+        wk=ns(None, None, "tp"),
+        wv=ns(None, None, "tp"),
+        wo=ns(None, "tp", None),
+        w1=ns(None, None, "tp"),
+        w2=ns(None, "tp", None),
+        w3=ns(None, None, "tp"),
+        rms_att=ns(None, None),
+        rms_ffn=ns(None, None),
+    )
+    return LlamaParams(
+        # embedding replicated: the reference keeps it root-only
+        # (src/llm.cpp:185-192); replication avoids a gather per step
+        embedding=ns(None, None),
+        layers=layers,
+        rms_final=ns(None),
+        # logits row-sliced across tp like final_matmul_logits (src/llm.cpp:420-432)
+        wcls=ns(None, "tp"),
+        rope_cos=ns(None, None),
+        rope_sin=ns(None, None),
+    )
+
+
+def cache_shardings(mesh: Mesh) -> KVCache:
+    """KV cache [L, B, S, n_kv, hd]: lanes over dp, sequence over sp, kv heads
+    over tp (the reference shards only kvDim via TP, src/nn/nn-core.cpp:198-205;
+    sp adds the sequence dimension it lacks, SURVEY.md §5.7)."""
+    ns = lambda *spec: NamedSharding(mesh, P(*spec))
+    return KVCache(
+        k=ns(None, "dp", "sp", "tp", None),
+        v=ns(None, "dp", "sp", "tp", None),
+    )
+
+
+def data_shardings(mesh: Mesh):
+    """(tokens/positions [B, T], logits [B, T, vocab]) shardings."""
+    return (
+        NamedSharding(mesh, P("dp", None)),
+        NamedSharding(mesh, P("dp", None, "tp")),
+    )
+
+
+def shard_params(params: LlamaParams, mesh: Mesh) -> LlamaParams:
+    """Place a host-side params pytree onto the mesh with TP/DP shardings —
+    the moment that replaces the reference's root-splits-and-ships-weights
+    protocol (NnRootWeightLoader, src/nn/nn-network.cpp:824-901)."""
+    shardings = param_shardings(mesh)
+    return jax.tree.map(jax.device_put, params, shardings)

@@ -1,0 +1,47 @@
+"""On-device (JAX) Q80 block codec — the single definition used by both the
+activation-quantization emulation (models/llama.py) and the compressed
+collectives (parallel/collectives.py).
+
+Semantics match the host codec in ``codec.py``:
+- mode="runtime": roundf ties-away-from-zero (src/nn/nn-quants.cpp:154-172)
+- mode="converter": np.round ties-to-even (converter/writer.py:55-74)
+The inverse scale is computed from the float32 delta; the fp16-rounded delta
+is used only for dequantization (nn-quants.cpp:165-170).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+Q80_BLOCK = 32
+
+
+def q80_encode_blocks(x: jnp.ndarray, mode: str = "runtime"):
+    """x: [..., n] with n % 32 == 0. Returns (q int8 [..., n/32, 32],
+    scales f16 [..., n/32, 1])."""
+    shape = x.shape
+    assert shape[-1] % Q80_BLOCK == 0, shape
+    xf = x.astype(jnp.float32).reshape(*shape[:-1], shape[-1] // Q80_BLOCK, Q80_BLOCK)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    d32 = amax / 127.0
+    inv = jnp.where(d32 != 0, 1.0 / jnp.where(d32 == 0, 1.0, d32), 0.0)
+    scaled = xf * inv
+    if mode == "runtime":
+        q = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+    elif mode == "converter":
+        q = jnp.round(scaled)
+    else:
+        raise ValueError(mode)
+    q = jnp.clip(q, -128, 127).astype(jnp.int8)
+    return q, d32.astype(jnp.float16)
+
+
+def q80_decode_blocks(q: jnp.ndarray, scales: jnp.ndarray, out_shape) -> jnp.ndarray:
+    """Inverse of q80_encode_blocks; scales applied at their fp16 rounding."""
+    return (q.astype(jnp.float32) * scales.astype(jnp.float32)).reshape(out_shape)
+
+
+def qdq_q80(x: jnp.ndarray, mode: str = "runtime") -> jnp.ndarray:
+    """Quantize-dequantize round trip along the last axis."""
+    q, s = q80_encode_blocks(x, mode=mode)
+    return q80_decode_blocks(q, s, x.shape).astype(x.dtype)
