@@ -1,0 +1,77 @@
+"""CLI argument surface — flag-compatible with the reference's hand-rolled
+parser (src/app.cpp:33-146), reinterpreted for TPU where needed:
+
+--workers      reference: space-separated worker ip:port list; here: a device
+               count or mesh spec ("8" or "dp2,tp2,sp2") selecting how many
+               chips / which axes to shard over.
+--nthreads     reference: executor thread count; here: host-side threads
+               (tokenization etc.) — accepted, mostly advisory.
+--gpu-index / --gpu-segments / --net-turbo: accepted for CLI compatibility,
+               no-ops on TPU (single-program SPMD has no segment split or
+               socket turbo mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..quants.codec import FloatType
+
+
+def _float_type(s: str) -> int:
+    m = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40, "q80": FloatType.Q80}
+    if s not in m:
+        raise argparse.ArgumentTypeError(f"unknown float type {s!r}")
+    return m[s]
+
+
+def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog)
+    if not api:
+        p.add_argument("mode", choices=["inference", "chat", "worker"], help="run mode (src/dllama.cpp:216-239)")
+    p.add_argument("--model", help="path to .m model file")
+    p.add_argument("--tokenizer", help="path to .t tokenizer file")
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--steps", type=int, default=64, help="tokens to generate (inference mode)")
+    p.add_argument("--max-seq-len", type=int, default=0, help="clamp context length (src/llm.cpp:89-91)")
+    p.add_argument("--buffer-float-type", type=_float_type, default=FloatType.F32,
+                   help="activation quant emulation: q80 reproduces the reference's lossy "
+                        "activation casts (bit-fidelity mode); f32 (default) runs clean — "
+                        "the reference defaults to q80 because its TCP links need the "
+                        "bandwidth, which ICI does not")
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--nthreads", type=int, default=1)
+    p.add_argument("--max-lanes", type=int, default=8, help="concurrent request lanes (continuous batching)")
+    p.add_argument("--chat-template", default=None, choices=[None, "llama2", "llama3", "deepSeek3"])
+    p.add_argument("--workers", nargs="*", default=None,
+                   help="TPU: device count or mesh spec (dp2,tp4); reference compat")
+    p.add_argument("--port", type=int, default=9990)
+    p.add_argument("--host", default="0.0.0.0")
+    # accepted for reference CLI compatibility; no-ops on TPU:
+    p.add_argument("--gpu-index", type=int, default=-1, help=argparse.SUPPRESS)
+    p.add_argument("--gpu-segments", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--net-turbo", type=int, default=1, help=argparse.SUPPRESS)
+    p.add_argument("--benchmark", action="store_true", help="print per-token timing stats")
+    return p
+
+
+def parse_mesh_spec(workers: list[str] | None):
+    """--workers '8' -> tp=8 (reference pure-TP); 'dp2,tp2,sp2' -> explicit."""
+    from ..parallel import MeshPlan
+
+    if not workers:
+        return None
+    spec = workers[0]
+    if spec.isdigit():
+        return MeshPlan(tp=int(spec))
+    plan = {"dp": 1, "tp": 1, "sp": 1}
+    for part in spec.split(","):
+        for axis in plan:
+            if part.startswith(axis):
+                plan[axis] = int(part[len(axis):])
+                break
+        else:
+            raise ValueError(f"bad mesh spec part {part!r}")
+    return MeshPlan(**plan)

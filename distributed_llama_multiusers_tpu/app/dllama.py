@@ -1,0 +1,154 @@
+"""`dllama` CLI: inference | chat | worker (reference: src/dllama.cpp).
+
+- inference: prompt eval + N-token generation with per-token Eval/Pred
+  timing and a tok/s summary (src/dllama.cpp:36-113's 🔶/Evaluation/
+  Prediction readout).
+- chat: interactive chat with template rendering and streamed,
+  stop-string-gated output (src/dllama.cpp:130-214).
+- worker: in the reference, a TCP node that receives its program from the
+  root (src/app.cpp:405-464). Under single-program SPMD there is no worker
+  binary — additional chips join via the mesh (--workers N). For multi-host
+  pods, each host runs the same program with jax.distributed; this mode
+  prints the equivalent invocation and exits.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from ..tokenizer import ChatItem, ChatTemplateGenerator, EosDetector, EosResult, Sampler, TemplateType, TokenizerChatStops
+from .args import build_parser
+from .runtime_setup import load_stack, log
+
+
+def run_inference(args) -> None:
+    config, params, tokenizer, engine = load_stack(args, n_lanes=1)
+    prompt = args.prompt or "Hello"
+    tokens = tokenizer.encode(prompt)
+    log("📄", f"Prompt tokens: {len(tokens)}")
+    sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or 12345)
+
+    t0 = time.perf_counter()
+    logits, greedy, pos = engine.prefill(0, tokens)
+    eval_s = time.perf_counter() - t0
+    log("🔷", f"Eval {eval_s * 1000:8.2f} ms  ({len(tokens)} tokens, {len(tokens) / eval_s:.1f} tok/s)")
+
+    cur = greedy if args.temperature == 0.0 else sampler.sample(np.asarray(logits))
+    tokenizer.reset_decoder()
+    out_pieces = []
+    pred_times = []
+    toks = np.zeros(1, np.int32)
+    poss = np.zeros(1, np.int32)
+    for _ in range(args.steps):
+        piece = tokenizer.decode(cur)
+        if piece:
+            out_pieces.append(piece)
+            print(piece, end="", flush=True)
+        if tokenizer.is_eos(cur) or pos >= config.seq_len:
+            break
+        toks[0] = cur
+        poss[0] = pos
+        t1 = time.perf_counter()
+        logits_b, greedy_b = engine.decode(toks, poss)
+        dt = time.perf_counter() - t1
+        pred_times.append(dt)
+        if args.benchmark:
+            log("🔶", f"Pred {dt * 1000:8.2f} ms")
+        pos += 1
+        cur = int(greedy_b[0]) if args.temperature == 0.0 else sampler.sample(engine.lane_logits(logits_b, 0))
+    print()
+    if pred_times:
+        total = sum(pred_times)
+        log("⏱", f"Evaluation: {eval_s * 1000:.2f} ms ({len(tokens) / eval_s:.2f} tok/s)")
+        log("⏱", f"Prediction: {total * 1000:.2f} ms ({len(pred_times) / total:.2f} tok/s)")
+
+
+def run_chat(args) -> None:
+    config, params, tokenizer, engine = load_stack(args, n_lanes=1)
+    template_type = {
+        None: TemplateType.UNKNOWN,
+        "llama2": TemplateType.LLAMA2,
+        "llama3": TemplateType.LLAMA3,
+        "deepSeek3": TemplateType.DEEP_SEEK3,
+    }[args.chat_template]
+    eos_piece = (
+        tokenizer.vocab[tokenizer.eos_token_ids[0]].decode("utf-8", errors="replace")
+        if tokenizer.eos_token_ids
+        else ""
+    )
+    generator = ChatTemplateGenerator(template_type, tokenizer.chat_template, eos_piece)
+    stops = TokenizerChatStops(tokenizer)
+    sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or int(time.time()))
+
+    pos = 0
+    first = True
+    print("💬 Chat mode. Ctrl-D to exit.")
+    while True:
+        try:
+            user = input("\n> ")
+        except EOFError:
+            print()
+            return
+        items = []
+        if first and args.prompt:
+            items.append(ChatItem("system", args.prompt))
+        items.append(ChatItem("user", user))
+        chat = generator.generate(items, append_generation_prompt=True)
+        first = False
+
+        tokens = tokenizer.encode(chat.content, add_bos=(pos == 0))
+        if pos + len(tokens) >= config.seq_len:
+            log("🚫", "Context window full")
+            return
+        logits, greedy, pos = engine.prefill(0, tokens, start_pos=pos)
+        cur = greedy if args.temperature == 0.0 else sampler.sample(np.asarray(logits))
+
+        detector = EosDetector(tokenizer.eos_token_ids, stops.stops, 2, 2)
+        decoder = tokenizer.make_stream_decoder()
+        toks = np.zeros(1, np.int32)
+        poss = np.zeros(1, np.int32)
+        while pos < config.seq_len:
+            piece = decoder.decode(cur)
+            result = detector.append(cur, piece)
+            if result == EosResult.EOS:
+                delta = detector.get_delta()
+                if delta:
+                    print(delta, end="", flush=True)
+                break
+            if result == EosResult.NOT_EOS:
+                delta = detector.get_delta()
+                if delta:
+                    print(delta, end="", flush=True)
+                detector.reset()
+            toks[0] = cur
+            poss[0] = pos
+            logits_b, greedy_b = engine.decode(toks, poss)
+            pos += 1
+            cur = int(greedy_b[0]) if args.temperature == 0.0 else sampler.sample(engine.lane_logits(logits_b, 0))
+        print()
+
+
+def run_worker(args) -> None:
+    import jax
+
+    n = len(jax.devices())
+    log("⭕", "TPU runs single-program SPMD: no separate worker process is needed.")
+    log("⭕", f"This host sees {n} device(s); shard with: dllama inference --workers {n} ...")
+    log("⭕", "Multi-host pods: run the same command on every host (jax.distributed auto-init).")
+
+
+def main(argv=None) -> None:
+    args = build_parser("dllama").parse_args(argv)
+    if args.mode == "inference":
+        run_inference(args)
+    elif args.mode == "chat":
+        run_chat(args)
+    elif args.mode == "worker":
+        run_worker(args)
+
+
+if __name__ == "__main__":
+    main()

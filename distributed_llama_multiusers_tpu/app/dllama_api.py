@@ -1,0 +1,46 @@
+"""`dllama-api` entry point: the multi-user HTTP server
+(reference: src/dllama-api.cpp:388-411), backed by the continuous-batching
+scheduler instead of the fork's serialized accept loop."""
+
+from __future__ import annotations
+
+import os
+import signal
+
+from ..server import ApiServer
+from ..tokenizer import TemplateType
+from .args import build_parser
+from .runtime_setup import load_stack, log, make_scheduler
+
+
+def main(argv=None) -> None:
+    args = build_parser("dllama-api", api=True).parse_args(argv)
+    config, params, tokenizer, engine = load_stack(args)
+    scheduler = make_scheduler(engine, tokenizer)
+    template_type = {
+        None: TemplateType.UNKNOWN,
+        "llama2": TemplateType.LLAMA2,
+        "llama3": TemplateType.LLAMA3,
+        "deepSeek3": TemplateType.DEEP_SEEK3,
+    }[args.chat_template]
+    model_name = os.path.basename(args.model or "dllama")
+    server = ApiServer(scheduler, tokenizer, model_name=model_name, template_type=template_type)
+    httpd = server.serve(host=args.host, port=args.port)
+    log("⭐", f"Server listening on {args.host}:{args.port} ({engine.n_lanes} lanes)")
+
+    def _shutdown(*_):
+        log("⭐", "Shutting down")
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        scheduler.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Shared model/engine bootstrapping for the CLI entry points — the analogue
+of runInferenceApp's setup sequence (src/app.cpp:233-312): load header ->
+validate -> tokenizer -> build model -> place on devices -> engine."""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ..formats import load_model_header
+from ..models import load_params_from_m
+from ..parallel import make_mesh, validate_mesh_for_config
+from ..parallel.sharding import shard_params
+from ..runtime import ContinuousBatchingScheduler, InferenceEngine
+from ..tokenizer import Tokenizer
+from .args import parse_mesh_spec
+
+
+def log(emoji: str, msg: str) -> None:
+    print(f"{emoji} {msg}", flush=True)
+
+
+def load_stack(args, n_lanes: int | None = None):
+    """Returns (config, params, tokenizer, engine)."""
+    if not args.model or not args.tokenizer:
+        print("error: --model and --tokenizer are required", file=sys.stderr)
+        raise SystemExit(2)
+    header = load_model_header(args.model, max_seq_len=args.max_seq_len)
+    config_dtype = jnp.bfloat16
+    if jax.default_backend() == "cpu":
+        config_dtype = jnp.float32  # parity-friendly on host runs
+
+    log("💡", f"Dim: {header.dim}  HiddenDim: {header.hidden_dim}  Layers: {header.n_layers}")
+    log("💡", f"Heads: {header.n_heads}/{header.n_kv_heads}  Vocab: {header.vocab_size}  SeqLen: {header.seq_len}")
+
+    tokenizer = Tokenizer(args.tokenizer)
+    log("📄", f"Vocab: {tokenizer.vocab_size}  Bos: {tokenizer.bos_id}  Eos: {tokenizer.eos_token_ids}")
+
+    config, params = load_params_from_m(args.model, header, dtype=config_dtype)
+
+    plan = parse_mesh_spec(args.workers)
+    if plan is not None and plan.n_devices > 1:
+        validate_mesh_for_config(config, plan)
+        mesh = make_mesh(plan)
+        params = shard_params(params, mesh)
+        log("⭕", f"Mesh: dp={plan.dp} tp={plan.tp} sp={plan.sp} over {plan.n_devices} devices")
+    log("💿", "Weights loaded")
+
+    from ..quants.codec import FloatType
+
+    emulate_q80 = args.buffer_float_type == FloatType.Q80
+    if emulate_q80:
+        log("🔶", "Q80 activation-cast emulation enabled (--buffer-float-type q80)")
+    engine = InferenceEngine(
+        config,
+        params,
+        n_lanes=n_lanes or args.max_lanes,
+        cache_dtype=jnp.float32,
+        emulate_q80_activations=emulate_q80,
+    )
+    return config, params, tokenizer, engine
+
+
+def make_scheduler(engine, tokenizer) -> ContinuousBatchingScheduler:
+    sched = ContinuousBatchingScheduler(engine, tokenizer)
+    sched.start()
+    return sched

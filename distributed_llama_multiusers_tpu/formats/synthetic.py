@@ -101,8 +101,9 @@ def write_synthetic_tokenizer(path: str, vocab_size: int = 128) -> TokenizerData
     the reference's assumption, src/tokenizer.cpp:137-139)."""
     vocab: list[bytes] = []
     scores: list[float] = []
-    # 0..255 single bytes, score 0 — but keep it small: printable ASCII only
-    base = [bytes([b]) for b in range(32, 127)]
+    # keep it small: printable ASCII + whitespace (real tokenizers carry all
+    # 256 byte-fallback tokens; chat templates need \n)
+    base = [b"\t", b"\n", b"\r"] + [bytes([b]) for b in range(32, 127)]
     merges = [b"he", b"ll", b"hell", b"hello", b"wo", b"rl", b"worl", b"world", b"lo "]
     for t in base:
         vocab.append(t)

@@ -1,0 +1,2 @@
+from .engine import InferenceEngine
+from .scheduler import Request, RequestQueue, ContinuousBatchingScheduler

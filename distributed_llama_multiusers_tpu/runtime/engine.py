@@ -1,0 +1,185 @@
+"""Inference engine: compiled decode/prefill steps over a lane-based KV cache.
+
+This is the TPU-native replacement for the reference executor + forward loop
+(src/nn/nn-executor.cpp:134-187, src/app.cpp:179-231): instead of a
+spin-barrier thread pool stepping a flat op list and shipping control packets
+to workers, there are two compiled XLA programs —
+
+- ``decode``: one token for every lane at its own position (the whole
+  continuous batch advances in a single device step), and
+- ``prefill``: a bucketed prompt chunk for ONE lane (dynamic-sliced out of
+  the lane axis so other lanes' caches are untouched) — full prompt
+  processing, fixing reference defect (a).
+
+Shapes are bucketed (prompt chunks padded up to fixed sizes) so XLA compiles
+a handful of programs once, replacing the reference's dynamic ``batchSize``
+argument (nn-executor.cpp:171). All per-lane state (positions, sampling,
+stream decode) lives with the scheduler; the engine is stateless apart from
+the device-resident cache it threads through.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.config import LlamaConfig
+from ..models.llama import KVCache, LlamaParams, init_kv_cache, llama_forward
+
+DEFAULT_PREFILL_BUCKETS = (16, 64, 256, 1024)
+
+
+@dataclass
+class EngineStats:
+    """Per-call timing + transfer counters — the analogue of the reference's
+    per-step-type totalTime[] and socket byte counters (SURVEY.md §5.1)."""
+
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+    prefill_tokens: int = 0
+    decode_steps: int = 0
+    host_bytes_in: int = 0  # device->host logits traffic
+
+    def reset(self) -> "EngineStats":
+        snap = EngineStats(**self.__dict__)
+        self.prefill_s = self.decode_s = 0.0
+        self.prefill_tokens = self.decode_steps = self.host_bytes_in = 0
+        return snap
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: LlamaParams,
+        n_lanes: int = 8,
+        prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
+        cache_dtype=jnp.float32,
+        emulate_q80_activations: bool = False,
+    ):
+        self.config = config
+        self.params = params
+        self.n_lanes = n_lanes
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= config.seq_len
+        ) or (min(16, config.seq_len),)
+        self.cache = init_kv_cache(config, n_lanes, dtype=cache_dtype)
+        self.stats = EngineStats()
+
+        cfg = config
+        q80 = emulate_q80_activations
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _decode(params, cache, tokens, positions):
+            # tokens/positions: [n_lanes] -> [n_lanes, 1]
+            logits, cache = llama_forward(
+                cfg, params, tokens[:, None], positions[:, None], cache,
+                emulate_q80_activations=q80,
+            )
+            return logits[:, 0, :], jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32), cache
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _prefill(params, cache, lane, tokens, start_pos, n_tokens):
+            # tokens: [bucket] int32, first n_tokens real; lane, start_pos,
+            # n_tokens traced scalars (one compile per bucket size only).
+            bucket = tokens.shape[0]
+            # slice this lane's cache to batch-of-1
+            k_lane = jax.lax.dynamic_slice_in_dim(cache.k, lane, 1, axis=1)
+            v_lane = jax.lax.dynamic_slice_in_dim(cache.v, lane, 1, axis=1)
+            positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
+            # padded tail tokens write at positions >= start_pos + n_tokens,
+            # which later real writes overwrite before they become readable
+            # (mask s <= pos), so no masking is needed
+            logits, lane_cache = llama_forward(
+                cfg,
+                params,
+                tokens[None, :],
+                positions[None, :],
+                KVCache(k=k_lane, v=v_lane),
+                emulate_q80_activations=q80,
+            )
+            k = jax.lax.dynamic_update_slice_in_dim(cache.k, lane_cache.k, lane, axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)
+            last = jax.lax.dynamic_index_in_dim(logits[0], n_tokens - 1, axis=0, keepdims=False)
+            return last, jnp.argmax(last).astype(jnp.int32), KVCache(k=k, v=v)
+
+        self._decode_fn = _decode
+        self._prefill_fn = _prefill
+
+    # -- public API ---------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def prefill(self, lane: int, tokens: list[int], start_pos: int = 0):
+        """Process a full prompt on one lane in bucketed chunks. Returns
+        (last_logits np[vocab], greedy_token int, total_positions)."""
+        if start_pos + len(tokens) > self.config.seq_len:
+            raise ValueError(
+                f"prompt of {len(tokens)} tokens at pos {start_pos} exceeds "
+                f"seq_len {self.config.seq_len}"
+            )
+        t0 = time.perf_counter()
+        pos = start_pos
+        remaining = list(tokens)
+        last = greedy = None
+        while remaining:
+            chunk_max = self.prefill_buckets[-1]
+            chunk = remaining[:chunk_max]
+            remaining = remaining[len(chunk) :]
+            bucket = self.bucket_for(len(chunk))
+            padded = np.zeros(bucket, np.int32)
+            padded[: len(chunk)] = chunk
+            last, greedy, self.cache = self._prefill_fn(
+                self.params,
+                self.cache,
+                jnp.int32(lane),
+                jnp.asarray(padded),
+                jnp.int32(pos),
+                jnp.int32(len(chunk)),
+            )
+            pos += len(chunk)
+        jax.block_until_ready(last)
+        self.stats.prefill_s += time.perf_counter() - t0
+        self.stats.prefill_tokens += len(tokens)
+        return last, int(greedy), pos
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray):
+        """One decode step for all lanes. tokens/positions: int32 [n_lanes]
+        (idle lanes: any in-range position; their writes are never readable).
+        Returns (logits device-array [n_lanes, vocab], greedy np[n_lanes])."""
+        t0 = time.perf_counter()
+        logits, greedy, self.cache = self._decode_fn(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+        )
+        greedy_np = np.asarray(greedy)
+        self.stats.decode_s += time.perf_counter() - t0
+        self.stats.decode_steps += 1
+        return logits, greedy_np
+
+    def lane_logits(self, logits, lane: int) -> np.ndarray:
+        """Transfer one lane's logits to host (counted, for sampling)."""
+        out = np.asarray(logits[lane])
+        self.stats.host_bytes_in += out.nbytes
+        return out
+
+    def all_logits(self, logits) -> np.ndarray:
+        """Single batched device->host transfer of all lanes' logits."""
+        out = np.asarray(logits)
+        self.stats.host_bytes_in += out.nbytes
+        return out
+
+    def reset_lane(self, lane: int) -> None:
+        """Nothing to clear on device: a fresh request's prefill rewrites the
+        lane's cache from position 0, and reads are masked to s <= pos."""

@@ -1,0 +1,177 @@
+"""Multi-user HTTP API server.
+
+Routes match the reference's dllama-api (src/dllama-api.cpp:338-349):
+POST /v1/chat/completions and GET /v1/models, with CORS preflight.
+
+Concurrency model is where this departs from the fork: the fork accepts one
+connection at a time and blocks the accept loop on future.get()
+(dllama-api.cpp:250-288,351-365), so despite its batching loop only one HTTP
+request is ever in flight. Here a ThreadingHTTPServer gives every connection
+its own thread; all of them submit into the shared RequestQueue and their
+generations proceed concurrently in the continuous batch. SSE streaming
+(``"stream": true``) is supported — upstream shipped the chunk types but
+never wired them (api-types.hpp:45-57).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..runtime.scheduler import Request
+from ..tokenizer import ChatItem, ChatTemplateGenerator, TemplateType
+from . import api_types
+
+
+class ApiServer:
+    def __init__(self, scheduler, tokenizer, model_name: str = "dllama", template_type: TemplateType = TemplateType.UNKNOWN):
+        self.scheduler = scheduler
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        eos_piece = (
+            tokenizer.vocab[tokenizer.eos_token_ids[0]].decode("utf-8", errors="replace")
+            if tokenizer.eos_token_ids
+            else ""
+        )
+        self.chat_template = ChatTemplateGenerator(template_type, tokenizer.chat_template, eos_piece)
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # -- request handling ---------------------------------------------------
+
+    def handle_chat_completion(self, body: dict, send_chunk=None) -> dict:
+        """Build the prompt with the chat template, run it through the shared
+        batching loop. If send_chunk is given, stream deltas through it."""
+        messages = api_types.parse_chat_messages(body)
+        params = api_types.InferenceParams.from_body(body)
+        chat = self.chat_template.generate(
+            [ChatItem(m.role, m.content) for m in messages], append_generation_prompt=True
+        )
+
+        deltas: "queue.Queue[str | None]" = queue.Queue()
+        req = Request(
+            prompt=chat.content,
+            max_tokens=params.max_tokens,
+            temperature=params.temperature,
+            topp=params.top_p,
+            seed=params.seed,
+            stop=params.stop,
+            on_delta=(deltas.put if send_chunk else None),
+        )
+        self.scheduler.submit(req)
+
+        if send_chunk:
+            req.future.add_done_callback(lambda _f: deltas.put(None))
+            try:
+                while True:
+                    delta = deltas.get()
+                    if delta is None:
+                        break
+                    send_chunk(api_types.chat_chunk_response(self.model_name, req.id, delta, False))
+                req.future.result()  # re-raise failures
+                send_chunk(
+                    api_types.chat_chunk_response(
+                        self.model_name, req.id, None, True, req.finish_reason or "stop"
+                    )
+                )
+            except (BrokenPipeError, ConnectionError, OSError):
+                # client went away: free the lane instead of generating to
+                # max_tokens into an orphaned queue
+                req.cancel()
+                raise
+            return {}
+
+        text = req.future.result()
+        return api_types.chat_completion_response(
+            self.model_name, req.id, text, req.n_prompt_tokens, len(req.generated_tokens),
+            req.finish_reason or "stop",
+        )
+
+    def handle_models(self) -> dict:
+        return api_types.models_response(self.model_name)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def serve(self, host: str = "0.0.0.0", port: int = 9990) -> ThreadingHTTPServer:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _cors(self):
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
+                self.send_header("Access-Control-Allow-Headers", "Content-Type, Authorization")
+
+            def _json(self, code: int, payload: dict):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self._cors()
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_OPTIONS(self):  # CORS preflight (dllama-api.cpp:228-236)
+                self.send_response(204)
+                self._cors()
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                if self.path == "/v1/models":
+                    self._json(200, api.handle_models())
+                elif self.path in ("/", "/health"):
+                    self._json(200, {"status": "ok", "model": api.model_name})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/chat/completions":
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    if body.get("stream"):
+                        self.send_response(200)
+                        self._cors()
+                        self.send_header("Content-Type", "text/event-stream")
+                        self.send_header("Cache-Control", "no-cache")
+                        self.send_header("Connection", "close")
+                        self.end_headers()
+
+                        def send_chunk(payload: dict):
+                            self.wfile.write(b"data: " + json.dumps(payload).encode() + b"\n\n")
+                            self.wfile.flush()
+
+                        try:
+                            api.handle_chat_completion(body, send_chunk=send_chunk)
+                            self.wfile.write(b"data: [DONE]\n\n")
+                        except (BrokenPipeError, ConnectionError, OSError):
+                            return  # client gone; request already cancelled
+                        except Exception as e:  # headers already sent: SSE error event
+                            send_chunk({"error": str(e)})
+                            self.wfile.write(b"data: [DONE]\n\n")
+                    else:
+                        self._json(200, api.handle_chat_completion(body))
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                except Exception as e:  # generation failure
+                    self._json(500, {"error": str(e)})
+
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = httpd
+        return httpd
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

@@ -109,6 +109,12 @@ class Tokenizer:
     def is_eos(self, token: int) -> bool:
         return token in self.eos_token_ids
 
+    def make_stream_decoder(self) -> "StreamDecoder":
+        """Independent streaming decoder — one per concurrent request lane
+        (the reference has a single shared strBuffer, src/tokenizer.cpp:154,
+        which the multi-user loop bypassed entirely — defect (e))."""
+        return StreamDecoder(self)
+
     def reset_decoder(self) -> None:
         self._decode_pending = b""
 
@@ -139,58 +145,85 @@ class Tokenizer:
         return "".join(p for p in parts if p) + pending
 
     def _detok_utf8(self, data: bytes) -> str | None:
-        """Port of detokUtf8 (src/tokenizer.cpp:214-279): emit the valid
-        prefix, collapse runs of invalid bytes into a single U+FFFD, hold back
-        an incomplete trailing sequence for the next call."""
-        out = bytearray()
-        i = 0
-        n = len(data)
-        checkpoint_out = 0  # bytes of `out` confirmed (ends on char boundary)
-        checkpoint_src = 0
-        expect = 0
-        while i < n:
-            c = data[i]
-            need_recovery = False
-            if expect:
-                if (c & 0xC0) == 0x80:
-                    out.append(c)
-                    i += 1
-                    expect -= 1
-                else:
-                    need_recovery = True
-            elif c <= 0x7F:
+        out, self._decode_pending = _detok_utf8(data)
+        return out
+
+
+def _detok_utf8(data: bytes) -> tuple[str | None, bytes]:
+    """Pure port of detokUtf8 (src/tokenizer.cpp:214-279): emit the valid
+    prefix, collapse runs of invalid bytes into a single U+FFFD, return
+    (text, held-back bytes of an incomplete trailing sequence)."""
+    out = bytearray()
+    i = 0
+    n = len(data)
+    checkpoint_out = 0  # bytes of `out` confirmed (ends on char boundary)
+    checkpoint_src = 0
+    expect = 0
+    while i < n:
+        c = data[i]
+        need_recovery = False
+        if expect:
+            if (c & 0xC0) == 0x80:
                 out.append(c)
                 i += 1
-            elif 0xC0 <= c <= 0xDF:
-                out.append(c)
-                i += 1
-                expect = 1
-            elif 0xE0 <= c <= 0xEF:
-                out.append(c)
-                i += 1
-                expect = 2
-            elif 0xF0 <= c <= 0xF7:
-                out.append(c)
-                i += 1
-                expect = 3
+                expect -= 1
             else:
                 need_recovery = True
-
-            if not need_recovery:
-                if expect == 0:
-                    checkpoint_out = len(out)
-                    checkpoint_src = i
-            else:
-                if expect:
-                    expect = 0
-                else:
-                    i += 1
-                del out[checkpoint_out:]
-                out += _FFFD
-        if i > checkpoint_src:
-            self._decode_pending = data[checkpoint_src:]
+        elif c <= 0x7F:
+            out.append(c)
+            i += 1
+        elif 0xC0 <= c <= 0xDF:
+            out.append(c)
+            i += 1
+            expect = 1
+        elif 0xE0 <= c <= 0xEF:
+            out.append(c)
+            i += 1
+            expect = 2
+        elif 0xF0 <= c <= 0xF7:
+            out.append(c)
+            i += 1
+            expect = 3
         else:
-            self._decode_pending = b""
-        if checkpoint_out > 0:
-            return bytes(out[:checkpoint_out]).decode("utf-8", errors="replace")
-        return None
+            need_recovery = True
+
+        if not need_recovery:
+            if expect == 0:
+                checkpoint_out = len(out)
+                checkpoint_src = i
+        else:
+            if expect:
+                expect = 0
+            else:
+                i += 1
+            del out[checkpoint_out:]
+            out += _FFFD
+    pending = data[checkpoint_src:] if i > checkpoint_src else b""
+    if checkpoint_out > 0:
+        return bytes(out[:checkpoint_out]).decode("utf-8", errors="replace"), pending
+    return None, pending
+
+
+class StreamDecoder:
+    """Per-request streaming decoder sharing a Tokenizer's vocab but owning
+    its own held-back-bytes state, so concurrent lanes never interleave."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._t = tokenizer
+        self._pending = b""
+
+    def decode(self, token: int) -> str | None:
+        t = self._t
+        if token == t.bos_id:
+            return None
+        if t.is_eos(token):
+            if self._pending:
+                out = self._pending.decode("utf-8", errors="replace")
+                self._pending = b""
+                return out
+            return None
+        out, self._pending = _detok_utf8(self._pending + t.vocab[token])
+        return out
+
+    def reset(self) -> None:
+        self._pending = b""
