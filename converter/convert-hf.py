@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Convert a HuggingFace Llama/Mistral checkpoint folder to the `.m` format.
+
+Usage: python convert-hf.py <sourceFolderPath> <weightsFloatType> <name>
+
+Reimplementation of the reference converter (converter/convert-hf.py):
+- tensor order must match the runtime walk (src/llm.cpp:447-483 /
+  formats/model_file.py model_tensor_specs)
+- Q and K projections are permuted from HF half-rotation layout to the
+  interleaved-rotary layout the runtime's RoPE expects
+  (reference converter/convert-hf.py:11-14)
+- embeddings/norms stay F32; lm_head falls back to the tied embedding
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_llama_multiusers_tpu.formats.model_file import ArchType, HiddenAct, ModelHeader, RopeType
+from distributed_llama_multiusers_tpu.quants.codec import FloatType
+from writer import parse_float_type, write_header, write_tensor
+
+
+def permute_rotary(w: "np.ndarray", n_heads: int) -> "np.ndarray":
+    """HF half-rotation -> interleaved layout: row blocks [h, 2, d/2] -> [h, d/2, 2]."""
+    d_out, d_in = w.shape
+    return (
+        w.reshape(n_heads, 2, d_out // n_heads // 2, d_in).swapaxes(1, 2).reshape(d_out, d_in)
+    )
+
+
+class SafetensorsIndex:
+    """Lazy tensor lookup across sharded safetensors files, loading one file
+    at a time (the reference's Processor.__loadModel memory discipline)."""
+
+    def __init__(self, files: list[str]):
+        from safetensors import safe_open
+
+        self._open = safe_open
+        self._key_to_file: dict[str, str] = {}
+        for path in files:
+            with safe_open(path, framework="pt", device="cpu") as f:
+                for k in f.keys():
+                    self._key_to_file[k] = path
+        self._current_path: str | None = None
+        self._current = None
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._key_to_file
+
+    def get(self, key: str) -> np.ndarray:
+        import torch
+
+        path = self._key_to_file[key]
+        if path != self._current_path:
+            self._current = self._open(path, framework="pt", device="cpu").__enter__()
+            self._current_path = path
+            print(f"💿 reading {os.path.basename(path)}")
+        t = self._current.get_tensor(key)
+        return t.to(torch.float32).numpy()
+
+
+def load_config(folder: str, weight_type: int) -> tuple[ModelHeader, dict]:
+    with open(os.path.join(folder, "config.json")) as f:
+        cfg = json.load(f)
+    arch = {"llama": ArchType.LLAMA, "mistral": ArchType.LLAMA}.get(cfg["model_type"])
+    if arch is None:
+        raise ValueError(f"Unsupported arch type: {cfg['model_type']}")
+    act = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}.get(cfg["hidden_act"])
+    if act is None:
+        raise ValueError(f"Unsupported hidden act: {cfg['hidden_act']}")
+    h = ModelHeader(
+        version=0,
+        arch_type=arch,
+        hidden_act=act,
+        dim=cfg["hidden_size"],
+        hidden_dim=cfg["intermediate_size"],
+        n_layers=cfg["num_hidden_layers"],
+        n_heads=cfg["num_attention_heads"],
+        n_kv_heads=cfg["num_key_value_heads"],
+        weight_type=weight_type,
+        seq_len=cfg["max_position_embeddings"],
+        orig_seq_len=cfg["max_position_embeddings"],
+        vocab_size=cfg["vocab_size"],
+        rope_theta=float(cfg.get("rope_theta", 10000.0)),
+    )
+    n_experts = cfg.get("num_local_experts")
+    if n_experts:
+        raise NotImplementedError(
+            "MoE checkpoints are not supported yet (the reference runtime also "
+            "only executes dense Llama, src/llm.cpp:21-24)"
+        )
+    scaling = cfg.get("rope_scaling")
+    if scaling is not None and scaling.get("rope_type") in ("llama3",):
+        h.rope_type = RopeType.LLAMA3_1
+        h.rope_scaling_factor = float(scaling["factor"])
+        h.rope_scaling_low_freq_factor = float(scaling["low_freq_factor"])
+        h.rope_scaling_high_freq_factor = float(scaling["high_freq_factor"])
+        h.rope_scaling_orig_max_seq_len = int(scaling["original_max_position_embeddings"])
+    elif scaling is not None and scaling.get("rope_type") not in (None, "default"):
+        raise ValueError(f"Unsupported rope scaling: {scaling}")
+    return h, cfg
+
+
+def convert(folder: str, weight_type: int, out_path: str) -> None:
+    header, cfg = load_config(folder, weight_type)
+    files = sorted(
+        os.path.join(folder, f)
+        for f in os.listdir(folder)
+        if f.endswith(".safetensors") and not f.startswith(".")
+    )
+    if not files:
+        raise FileNotFoundError("No .safetensors files found")
+    index = SafetensorsIndex(files)
+    wt = weight_type
+    n_heads, n_kv = header.n_heads, header.n_kv_heads
+
+    with open(out_path, "wb") as out:
+        write_header(out, header)
+        write_tensor(out, index.get("model.embed_tokens.weight"), FloatType.F32)
+        for l in range(header.n_layers):
+            pre = f"model.layers.{l}"
+            write_tensor(out, permute_rotary(index.get(f"{pre}.self_attn.q_proj.weight"), n_heads), wt)
+            write_tensor(out, permute_rotary(index.get(f"{pre}.self_attn.k_proj.weight"), n_kv), wt)
+            write_tensor(out, index.get(f"{pre}.self_attn.v_proj.weight"), wt)
+            write_tensor(out, index.get(f"{pre}.self_attn.o_proj.weight"), wt)
+            write_tensor(out, index.get(f"{pre}.mlp.gate_proj.weight"), wt)  # w1
+            write_tensor(out, index.get(f"{pre}.mlp.down_proj.weight"), wt)  # w2
+            write_tensor(out, index.get(f"{pre}.mlp.up_proj.weight"), wt)  # w3
+            write_tensor(out, index.get(f"{pre}.input_layernorm.weight"), FloatType.F32)
+            write_tensor(out, index.get(f"{pre}.post_attention_layernorm.weight"), FloatType.F32)
+        write_tensor(out, index.get("model.norm.weight"), FloatType.F32)
+        head_key = "lm_head.weight" if "lm_head.weight" in index else "model.embed_tokens.weight"
+        write_tensor(out, index.get(head_key), wt)
+    print(f"✅ {out_path} created successfully")
+
+
+def main() -> None:
+    if len(sys.argv) < 4:
+        print("Usage: python convert-hf.py <sourceFolderPath> <weightsFloatType> <name>")
+        raise SystemExit(1)
+    folder = sys.argv[1]
+    weight_type = parse_float_type(sys.argv[2])
+    name = sys.argv[3]
+    convert(folder, weight_type, f"dllama_model_{name}_{sys.argv[2]}.m")
+
+
+if __name__ == "__main__":
+    main()

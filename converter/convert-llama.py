@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Convert original Meta Llama checkpoints (consolidated.*.pth shards) to `.m`.
+
+Usage: python convert-llama.py <modelPath> <weightsFloatType>
+
+Reimplementation of the reference (converter/convert-llama.py): shards are
+merged by concatenating along the tensor-parallel split dim of each weight
+class; layers are processed in chunks so at most one pass of shard files is
+resident. Q/K are NOT permuted: Meta checkpoints are already in interleaved-
+rotary layout (the HF permutation is what undoes it; reference behaves the
+same way).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_llama_multiusers_tpu.formats.model_file import ArchType, HiddenAct, ModelHeader, RopeType
+from distributed_llama_multiusers_tpu.quants.codec import FloatType
+from writer import parse_float_type, write_header, write_tensor
+
+# concat dim per tensor suffix: 0 = output-dim sharded, 1 = input-dim sharded,
+# None = replicated (take shard 0)
+CONCAT_DIM = {
+    "tok_embeddings.weight": 1,
+    "output.weight": 0,
+    "attention.wq.weight": 0,
+    "attention.wk.weight": 0,
+    "attention.wv.weight": 0,
+    "attention.wo.weight": 1,
+    "feed_forward.w1.weight": 0,
+    "feed_forward.w2.weight": 1,
+    "feed_forward.w3.weight": 0,
+    "attention_norm.weight": None,
+    "ffn_norm.weight": None,
+    "norm.weight": None,
+}
+
+
+def merge(shards: list, key: str) -> np.ndarray:
+    import torch
+
+    parts = [s[key] for s in shards]
+    dim = CONCAT_DIM[key.split(".", 2)[-1] if key.startswith("layers.") else key]
+    if dim is None or len(parts) == 1:
+        t = parts[0]
+    else:
+        t = torch.cat([p for p in parts], dim=dim)
+    return t.to(torch.float32).numpy()
+
+
+def convert(folder: str, weight_type: int, out_path: str) -> None:
+    import torch
+
+    with open(os.path.join(folder, "params.json")) as f:
+        params = json.load(f)
+    shard_paths = sorted(
+        os.path.join(folder, f) for f in os.listdir(folder) if f.startswith("consolidated.")
+    )
+    if not shard_paths:
+        raise FileNotFoundError("No consolidated.*.pth files found")
+    print(f"💿 loading {len(shard_paths)} shard(s)...")
+    shards = [torch.load(p, map_location="cpu", weights_only=True) for p in shard_paths]
+
+    dim = params["dim"]
+    n_heads = params["n_heads"]
+    n_kv_heads = params.get("n_kv_heads", n_heads)
+    embed = merge(shards, "tok_embeddings.weight")
+    vocab_size = params.get("vocab_size") or embed.shape[0]
+    hidden_dim = merge(shards, "layers.0.feed_forward.w1.weight").shape[0]
+
+    header = ModelHeader(
+        version=0,
+        arch_type=ArchType.LLAMA,
+        hidden_act=HiddenAct.SILU,
+        dim=dim,
+        hidden_dim=hidden_dim,
+        n_layers=params["n_layers"],
+        n_heads=n_heads,
+        n_kv_heads=n_kv_heads,
+        weight_type=weight_type,
+        seq_len=params.get("max_seq_len", 2048),
+        orig_seq_len=params.get("max_seq_len", 2048),
+        vocab_size=vocab_size,
+        rope_theta=float(params.get("rope_theta", 10000.0)),
+    )
+    if params.get("use_scaled_rope"):
+        header.rope_type = RopeType.LLAMA3_1
+        header.rope_scaling_factor = float(params.get("rope_scale_factor", 8.0))
+        header.rope_scaling_low_freq_factor = 1.0
+        header.rope_scaling_high_freq_factor = 4.0
+        header.rope_scaling_orig_max_seq_len = params.get("original_max_position_embeddings", 8192)
+
+    wt = weight_type
+    with open(out_path, "wb") as out:
+        write_header(out, header)
+        write_tensor(out, embed, FloatType.F32)
+        del embed
+        gc.collect()
+        for l in range(header.n_layers):
+            pre = f"layers.{l}"
+            write_tensor(out, merge(shards, f"{pre}.attention.wq.weight"), wt)
+            write_tensor(out, merge(shards, f"{pre}.attention.wk.weight"), wt)
+            write_tensor(out, merge(shards, f"{pre}.attention.wv.weight"), wt)
+            write_tensor(out, merge(shards, f"{pre}.attention.wo.weight"), wt)
+            write_tensor(out, merge(shards, f"{pre}.feed_forward.w1.weight"), wt)
+            write_tensor(out, merge(shards, f"{pre}.feed_forward.w2.weight"), wt)
+            write_tensor(out, merge(shards, f"{pre}.feed_forward.w3.weight"), wt)
+            write_tensor(out, merge(shards, f"{pre}.attention_norm.weight"), FloatType.F32)
+            write_tensor(out, merge(shards, f"{pre}.ffn_norm.weight"), FloatType.F32)
+        write_tensor(out, merge(shards, "norm.weight"), FloatType.F32)
+        write_tensor(out, merge(shards, "output.weight"), wt)
+    print(f"✅ {out_path} created successfully")
+
+
+def main() -> None:
+    if len(sys.argv) < 3:
+        print("Usage: python convert-llama.py <modelPath> <weightsFloatType>")
+        raise SystemExit(1)
+    folder = sys.argv[1]
+    weight_type = parse_float_type(sys.argv[2])
+    name = os.path.basename(os.path.normpath(folder)).lower()
+    convert(folder, weight_type, f"dllama_model_{name}_{sys.argv[2]}.m")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Convert a HuggingFace fast tokenizer (tokenizer.json + tokenizer_config.json)
+to the `.t` format.
+
+Usage: python convert-tokenizer-hf.py <sourceFolderPath> <name>
+
+Reimplementation of the reference (converter/convert-tokenizer-hf.py): the
+GPT-2 unicode<->byte table maps the BPE vocab's printable-unicode encoding
+back to raw bytes; merge ranks become negative scores so the runtime's
+best-score merge reproduces HF merge order; special/added tokens go after
+bos (the regular/special split point, src/tokenizer.cpp:137-139 assumption).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_llama_multiusers_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer_file
+
+
+def gpt2_byte_decoder() -> dict[str, int]:
+    """The printable-unicode <-> byte bijection used by byte-level BPE."""
+    bs = list(range(ord("!"), ord("~") + 1)) + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def token_to_bytes(token: str, byte_decoder: dict[str, int]) -> bytes:
+    try:
+        return bytes(byte_decoder[ch] for ch in token)
+    except KeyError:
+        # not byte-level-encoded (e.g. sentencepiece-style metaspace)
+        return token.replace("▁", " ").encode("utf-8")
+
+
+def convert(folder: str, out_path: str) -> TokenizerData:
+    with open(os.path.join(folder, "tokenizer.json")) as f:
+        tok = json.load(f)
+    config = {}
+    cfg_path = os.path.join(folder, "tokenizer_config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            config = json.load(f)
+
+    model = tok["model"]
+    if model.get("type") != "BPE":
+        raise ValueError(f"Unsupported tokenizer model type {model.get('type')}")
+    vocab_map: dict[str, int] = model["vocab"]
+    merges = model.get("merges", [])
+    byte_decoder = gpt2_byte_decoder()
+
+    n_regular = len(vocab_map)
+    vocab: list[bytes] = [b"?"] * n_regular
+    scores: list[float] = [0.0] * n_regular
+    for token, tid in vocab_map.items():
+        vocab[tid] = token_to_bytes(token, byte_decoder)
+    # merge rank -> score: earlier merges must win, and all merges must beat
+    # the zero default, so score = nMerges - rank (reference uses the same idea)
+    for rank, merge in enumerate(merges):
+        pair = merge.split(" ") if isinstance(merge, str) else merge
+        merged = "".join(pair)
+        tid = vocab_map.get(merged)
+        if tid is not None:
+            scores[tid] = float(len(merges) - rank)
+
+    added = sorted(tok.get("added_tokens", []), key=lambda t: t["id"])
+    specials = [(t["id"], t["content"].encode("utf-8")) for t in added if t["id"] >= n_regular]
+    for tid, content in specials:
+        while len(vocab) <= tid:
+            vocab.append(b"<|pad_%d|>" % len(vocab))
+            scores.append(0.0)
+        vocab[tid] = content
+        scores[tid] = 0.0
+
+    def find_id(name: str | dict | None) -> int | None:
+        if name is None:
+            return None
+        if isinstance(name, dict):
+            name = name.get("content")
+        b = name.encode("utf-8")
+        for tid, content in specials:
+            if content == b:
+                return tid
+        try:
+            return vocab.index(b)
+        except ValueError:
+            return None
+
+    bos_id = find_id(config.get("bos_token"))
+    eos_id = find_id(config.get("eos_token"))
+    if bos_id is None:
+        bos_id = min((tid for tid, _ in specials), default=n_regular)
+    eos_ids = [eos_id] if eos_id is not None else []
+    eot = find_id("<|eot_id|>")
+    if eot is not None and eot not in eos_ids:
+        eos_ids.append(eot)
+
+    data = TokenizerData(
+        vocab=vocab,
+        scores=scores,
+        bos_id=bos_id,
+        eos_token_ids=eos_ids,
+        chat_template=config.get("chat_template"),
+    )
+    with open(out_path, "wb") as f:
+        write_tokenizer_file(f, data)
+    print(f"✅ {out_path}: vocab {len(vocab)}, bos {bos_id}, eos {eos_ids}")
+    return data
+
+
+def main() -> None:
+    if len(sys.argv) < 3:
+        print("Usage: python convert-tokenizer-hf.py <sourceFolderPath> <name>")
+        raise SystemExit(1)
+    convert(sys.argv[1], f"dllama_tokenizer_{sys.argv[2]}.t")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Convert a Llama-2 sentencepiece `tokenizer.model` to the `.t` format.
+
+Usage: python convert-tokenizer-llama2.py <folderPathWithTokenizerModel>
+
+Reimplementation of the reference (converter/convert-tokenizer-llama2.py):
+sentencepiece pieces + scores; ▁ metaspace becomes a space byte; byte tokens
+<0xNN> become raw bytes; llama2 [INST] chat template embedded.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_llama_multiusers_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer_file
+
+LLAMA2_CHAT_TEMPLATE = (
+    "{% if messages[0]['role'] == 'system' %}[INST] <<SYS>>\n{{ messages[0]['content'] }}"
+    "\n<</SYS>>\n\n{% endif %}{% for message in messages %}"
+    "{% if message['role'] == 'user' %}[INST] {{ message['content'] }} [/INST]"
+    "{% elif message['role'] == 'assistant' %}{{ message['content'] }}{% endif %}{% endfor %}"
+)
+
+_BYTE_RE = re.compile(r"^<0x([0-9A-Fa-f]{2})>$")
+
+
+def convert(folder: str, out_path: str) -> None:
+    try:
+        import sentencepiece as spm
+    except ImportError as e:
+        raise SystemExit(
+            "sentencepiece is required for llama2 tokenizer conversion "
+            "(pip install sentencepiece)"
+        ) from e
+
+    model_path = os.path.join(folder, "tokenizer.model") if os.path.isdir(folder) else folder
+    sp = spm.SentencePieceProcessor(model_file=model_path)
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    for i in range(sp.vocab_size()):
+        piece = sp.id_to_piece(i)
+        m = _BYTE_RE.match(piece)
+        if m:
+            b = bytes([int(m.group(1), 16)])
+        else:
+            b = piece.replace("▁", " ").encode("utf-8")
+        vocab.append(b if b else b" ")
+        scores.append(float(sp.get_score(i)))
+
+    data = TokenizerData(
+        vocab=vocab,
+        scores=scores,
+        bos_id=sp.bos_id(),
+        eos_token_ids=[sp.eos_id()],
+        chat_template=LLAMA2_CHAT_TEMPLATE,
+    )
+    with open(out_path, "wb") as f:
+        write_tokenizer_file(f, data)
+    print(f"✅ {out_path}: vocab {len(vocab)}, bos {sp.bos_id()}, eos {sp.eos_id()}")
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        print("Usage: python convert-tokenizer-llama2.py <folderPathWithTokenizerModel>")
+        raise SystemExit(1)
+    convert(sys.argv[1], "dllama_tokenizer_llama2.t")
+
+
+if __name__ == "__main__":
+    main()

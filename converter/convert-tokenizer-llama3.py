@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Convert an original Meta Llama-3 `tokenizer.model` (tiktoken base64 ranks)
+to the `.t` format.
+
+Usage: python convert-tokenizer-llama3.py <tokenizerModelPath> [name]
+
+Reimplementation of the reference (converter/convert-tokenizer-llama3.py):
+256 reserved special tokens appended after the base vocab, llama3 chat
+template embedded, <|begin_of_text|> as bos, <|eot_id|>/<|end_of_text|> as eos.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_llama_multiusers_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer_file
+
+LLAMA3_CHAT_TEMPLATE = (
+    "{% set loop_messages = messages %}{% for message in loop_messages %}"
+    "{% set content = '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n'"
+    "+ message['content'] | trim + '<|eot_id|>' %}"
+    "{% if loop.index0 == 0 %}{% set content = bos_token + content %}{% endif %}"
+    "{{ content }}{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|start_header_id|>assistant<|end_header_id|>\n\n' }}{% endif %}"
+)
+
+SPECIAL_TOKENS = [
+    "<|begin_of_text|>",
+    "<|end_of_text|>",
+    "<|reserved_special_token_0|>",
+    "<|reserved_special_token_1|>",
+    "<|finetune_right_pad_id|>",
+    "<|step_id|>",
+    "<|start_header_id|>",
+    "<|end_header_id|>",
+    "<|eom_id|>",
+    "<|eot_id|>",
+    "<|python_tag|>",
+] + [f"<|reserved_special_token_{i}|>" for i in range(2, 247)]
+
+
+def convert(model_path: str, out_path: str) -> None:
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    with open(model_path, "rb") as f:
+        for rank, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            token_b64, _rank = line.split()
+            vocab.append(base64.b64decode(token_b64))
+            # descending scores preserve tiktoken merge priority under the
+            # runtime's best-score merge loop
+            scores.append(float(-rank))
+    n_base = len(vocab)
+    bos_id = n_base
+    eos_ids = []
+    for i, name in enumerate(SPECIAL_TOKENS):
+        vocab.append(name.encode("utf-8"))
+        scores.append(0.0)
+        if name in ("<|end_of_text|>", "<|eot_id|>"):
+            eos_ids.append(n_base + i)
+
+    data = TokenizerData(
+        vocab=vocab,
+        scores=scores,
+        bos_id=bos_id,
+        eos_token_ids=eos_ids,
+        chat_template=LLAMA3_CHAT_TEMPLATE,
+    )
+    with open(out_path, "wb") as f:
+        write_tokenizer_file(f, data)
+    print(f"✅ {out_path}: vocab {len(vocab)}, bos {bos_id}, eos {eos_ids}")
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        print("Usage: python convert-tokenizer-llama3.py <tokenizerModelPath> [name]")
+        raise SystemExit(1)
+    name = sys.argv[2] if len(sys.argv) > 2 else "llama3"
+    convert(sys.argv[1], f"dllama_tokenizer_{name}.t")
+
+
+if __name__ == "__main__":
+    main()

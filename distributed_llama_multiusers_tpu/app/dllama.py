@@ -19,7 +19,7 @@ import time
 
 import numpy as np
 
-from ..tokenizer import ChatItem, ChatTemplateGenerator, EosDetector, EosResult, Sampler, TemplateType, TokenizerChatStops
+from ..tokenizer import ChatItem, EosDetector, EosResult, Sampler, TokenizerChatStops, chat_generator_for
 from .args import build_parser
 from .runtime_setup import load_stack, log
 
@@ -68,18 +68,7 @@ def run_inference(args) -> None:
 
 def run_chat(args) -> None:
     config, params, tokenizer, engine = load_stack(args, n_lanes=1)
-    template_type = {
-        None: TemplateType.UNKNOWN,
-        "llama2": TemplateType.LLAMA2,
-        "llama3": TemplateType.LLAMA3,
-        "deepSeek3": TemplateType.DEEP_SEEK3,
-    }[args.chat_template]
-    eos_piece = (
-        tokenizer.vocab[tokenizer.eos_token_ids[0]].decode("utf-8", errors="replace")
-        if tokenizer.eos_token_ids
-        else ""
-    )
-    generator = ChatTemplateGenerator(template_type, tokenizer.chat_template, eos_piece)
+    generator = chat_generator_for(tokenizer, args.chat_template)
     stops = TokenizerChatStops(tokenizer)
     sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or int(time.time()))
 

@@ -8,7 +8,7 @@ import os
 import signal
 
 from ..server import ApiServer
-from ..tokenizer import TemplateType
+from ..tokenizer import template_type_from_name
 from .args import build_parser
 from .runtime_setup import load_stack, log, make_scheduler
 
@@ -17,12 +17,7 @@ def main(argv=None) -> None:
     args = build_parser("dllama-api", api=True).parse_args(argv)
     config, params, tokenizer, engine = load_stack(args)
     scheduler = make_scheduler(engine, tokenizer)
-    template_type = {
-        None: TemplateType.UNKNOWN,
-        "llama2": TemplateType.LLAMA2,
-        "llama3": TemplateType.LLAMA3,
-        "deepSeek3": TemplateType.DEEP_SEEK3,
-    }[args.chat_template]
+    template_type = template_type_from_name(args.chat_template)
     model_name = os.path.basename(args.model or "dllama")
     server = ApiServer(scheduler, tokenizer, model_name=model_name, template_type=template_type)
     httpd = server.serve(host=args.host, port=args.port)

@@ -122,6 +122,8 @@ class InferenceEngine:
     def prefill(self, lane: int, tokens: list[int], start_pos: int = 0):
         """Process a full prompt on one lane in bucketed chunks. Returns
         (last_logits np[vocab], greedy_token int, total_positions)."""
+        if not tokens:
+            raise ValueError("prefill needs at least one token (empty prompt)")
         if start_pos + len(tokens) > self.config.seq_len:
             raise ValueError(
                 f"prompt of {len(tokens)} tokens at pos {start_pos} exceeds "

@@ -224,7 +224,8 @@ class ContinuousBatchingScheduler:
             for i, lane in active:
                 if lane.request._cancelled.is_set():
                     self._finish(i, lane.request, reason="cancelled")
-            active = [(i, l) for i, l in active if l.request is not None]
+            # re-derive from self._lanes: _finish replaced the lane objects
+            active = [(i, self._lanes[i]) for i, _ in active if self._lanes[i].request is not None]
             if not active:
                 continue
 
@@ -268,7 +269,10 @@ class ContinuousBatchingScheduler:
                     lane.next_token = int(greedy[i])
                 else:
                     lane.next_token = lane.sampler.sample(logits_np[i])
-        # drain: fail any queued requests on shutdown
+        # drain: resolve everything still in flight so no client hangs
+        for i, lane in enumerate(self._lanes):
+            if lane.request is not None:
+                self._finish(i, lane.request, reason="cancelled")
         for req in self.queue.drain():
             req.state = RequestState.FAILED
             if not req.future.done():

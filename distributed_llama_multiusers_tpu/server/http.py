@@ -20,7 +20,7 @@ import queue
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..runtime.scheduler import Request
-from ..tokenizer import ChatItem, ChatTemplateGenerator, TemplateType
+from ..tokenizer import ChatItem, TemplateType, chat_generator_for
 from . import api_types
 
 
@@ -29,25 +29,19 @@ class ApiServer:
         self.scheduler = scheduler
         self.tokenizer = tokenizer
         self.model_name = model_name
-        eos_piece = (
-            tokenizer.vocab[tokenizer.eos_token_ids[0]].decode("utf-8", errors="replace")
-            if tokenizer.eos_token_ids
-            else ""
-        )
-        self.chat_template = ChatTemplateGenerator(template_type, tokenizer.chat_template, eos_piece)
+        self.chat_template = chat_generator_for(tokenizer, template_type)
         self._httpd: ThreadingHTTPServer | None = None
 
     # -- request handling ---------------------------------------------------
 
-    def handle_chat_completion(self, body: dict, send_chunk=None) -> dict:
-        """Build the prompt with the chat template, run it through the shared
-        batching loop. If send_chunk is given, stream deltas through it."""
+    def build_request(self, body: dict, streaming: bool) -> tuple[Request, "queue.Queue[str | None]"]:
+        """Validate the body and build the Request. Raises ValueError on bad
+        input — callers must do this BEFORE committing response headers."""
         messages = api_types.parse_chat_messages(body)
         params = api_types.InferenceParams.from_body(body)
         chat = self.chat_template.generate(
             [ChatItem(m.role, m.content) for m in messages], append_generation_prompt=True
         )
-
         deltas: "queue.Queue[str | None]" = queue.Queue()
         req = Request(
             prompt=chat.content,
@@ -56,8 +50,14 @@ class ApiServer:
             topp=params.top_p,
             seed=params.seed,
             stop=params.stop,
-            on_delta=(deltas.put if send_chunk else None),
+            on_delta=(deltas.put if streaming else None),
         )
+        return req, deltas
+
+    def handle_chat_completion(self, body: dict, send_chunk=None, prepared=None) -> dict:
+        """Run a (pre-validated) request through the shared batching loop.
+        If send_chunk is given, stream deltas through it."""
+        req, deltas = prepared if prepared is not None else self.build_request(body, send_chunk is not None)
         self.scheduler.submit(req)
 
         if send_chunk:
@@ -141,6 +141,9 @@ class ApiServer:
                     return
                 try:
                     if body.get("stream"):
+                        # validate BEFORE committing SSE headers so bad input
+                        # still gets a proper 400
+                        prepared = api.build_request(body, streaming=True)
                         self.send_response(200)
                         self._cors()
                         self.send_header("Content-Type", "text/event-stream")
@@ -153,7 +156,7 @@ class ApiServer:
                             self.wfile.flush()
 
                         try:
-                            api.handle_chat_completion(body, send_chunk=send_chunk)
+                            api.handle_chat_completion(body, send_chunk=send_chunk, prepared=prepared)
                             self.wfile.write(b"data: [DONE]\n\n")
                         except (BrokenPipeError, ConnectionError, OSError):
                             return  # client gone; request already cancelled

@@ -32,6 +32,29 @@ class GeneratedChat:
     public_prompt: str | None  # deepSeek3 exposes its injected "<think>\n" tail
 
 
+def template_type_from_name(name: str | None) -> TemplateType:
+    """CLI --chat-template value -> TemplateType (None = auto-detect)."""
+    return {
+        None: TemplateType.UNKNOWN,
+        "llama2": TemplateType.LLAMA2,
+        "llama3": TemplateType.LLAMA3,
+        "deepSeek3": TemplateType.DEEP_SEEK3,
+    }[name]
+
+
+def eos_piece_of(tokenizer: Tokenizer) -> str:
+    """The first EOS token's text — the template's turn terminator."""
+    if not tokenizer.eos_token_ids:
+        return ""
+    return tokenizer.vocab[tokenizer.eos_token_ids[0]].decode("utf-8", errors="replace")
+
+
+def chat_generator_for(tokenizer: Tokenizer, name_or_type=None) -> "ChatTemplateGenerator":
+    """Build a ChatTemplateGenerator from a tokenizer + optional CLI name."""
+    t = name_or_type if isinstance(name_or_type, TemplateType) else template_type_from_name(name_or_type)
+    return ChatTemplateGenerator(t, tokenizer.chat_template, eos_piece_of(tokenizer))
+
+
 class TokenizerChatStops:
     """Stop strings = the pieces of the tokenizer's EOS tokens
     (src/tokenizer.cpp:512-525)."""

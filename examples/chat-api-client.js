@@ -1,0 +1,36 @@
+// Exercise the OpenAI-style request shape against dllama-api
+// (reference: examples/chat-api-client.js). Node >= 18.
+//
+//   node examples/chat-api-client.js [http://localhost:9990]
+
+const API = process.argv[2] || "http://localhost:9990";
+
+async function main() {
+  const models = await (await fetch(`${API}/v1/models`)).json();
+  console.log("models:", models.data.map((m) => m.id).join(", "));
+
+  const body = {
+    messages: [
+      { role: "system", content: "You are a helpful assistant." },
+      { role: "user", content: "Say hello in five words." },
+    ],
+    max_tokens: 64,
+    temperature: 0.7,
+    top_p: 0.9,
+    stop: ["\n\n"],
+  };
+  const resp = await (
+    await fetch(`${API}/v1/chat/completions`, {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify(body),
+    })
+  ).json();
+  console.log("generated_text:", resp.generated_text);
+  console.log("finish_reason:", resp.choices[0].finish_reason, "usage:", resp.usage);
+}
+
+main().catch((e) => {
+  console.error(e);
+  process.exit(1);
+});

@@ -1,0 +1,13 @@
+#!/bin/sh
+# Long-generation KV-cache-filling regression check (reference: examples/macbeth.sh):
+# a long prompt + long generation exercises the full context window.
+# Usage: ./examples/macbeth.sh <model.m> <tokenizer.t>
+MODEL="${1:?model path}"
+TOK="${2:?tokenizer path}"
+PROMPT="Tomorrow, and tomorrow, and tomorrow, creeps in this petty pace from day to day, \
+to the last syllable of recorded time. And all our yesterdays have lighted fools the way \
+to dusty death. Out, out, brief candle. Life is but a walking shadow, a poor player that \
+struts and frets his hour upon the stage,"
+exec python -m distributed_llama_multiusers_tpu.app.dllama inference \
+  --model "$MODEL" --tokenizer "$TOK" \
+  --prompt "$PROMPT" --steps 256 --temperature 0 --max-seq-len 4096

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Fire N concurrent chat completions at a running dllama-api server — the
+local-cluster stress analogue of the reference's examples/n-workers.sh
+(here concurrency is request lanes, not worker processes).
+
+    python examples/multi-user-stress.py [url] [n_clients]
+"""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+URL = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:9990"
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+results = {}
+
+
+def client(i):
+    body = json.dumps(
+        {
+            "messages": [{"role": "user", "content": f"Tell me fact #{i} about llamas."}],
+            "max_tokens": 48,
+            "temperature": 0.7,
+            "seed": i,
+        }
+    ).encode()
+    req = urllib.request.Request(
+        URL + "/v1/chat/completions", data=body, headers={"Content-Type": "application/json"}
+    )
+    t0 = time.time()
+    with urllib.request.urlopen(req, timeout=600) as r:
+        out = json.loads(r.read())
+    results[i] = (time.time() - t0, out["usage"]["completion_tokens"])
+
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+t0 = time.time()
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+wall = time.time() - t0
+total_tokens = sum(n for _, n in results.values())
+print(f"{N} concurrent clients: {wall:.2f}s wall, {total_tokens} tokens, "
+      f"{total_tokens / wall:.1f} tok/s aggregate")
+for i, (dt, n) in sorted(results.items()):
+    print(f"  client {i}: {dt:6.2f}s  {n} tokens")

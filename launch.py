@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Model downloader + launcher (reference: launch.py).
+
+Downloads pre-converted .m/.t files from the distributed-llama release
+catalog (resumable, chunked), writes a run script, and optionally starts
+`dllama chat` / `dllama-api`.
+
+Usage:
+    python launch.py                       # list models
+    python launch.py llama3_2_1b_instruct_q40
+    python launch.py llama3_2_1b_instruct_q40 --run api
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import urllib.request
+
+# name -> (model urls (multi-part concatenated in order), tokenizer url)
+# catalog mirrors the reference's (launch.py:16-47; huggingface-hosted)
+_HF = "https://huggingface.co/b4rtaz"
+CATALOG: dict[str, tuple[list[str], str]] = {
+    "llama3_1_8b_instruct_q40": (
+        [f"{_HF}/Llama-3_1-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_model_llama3.1_instruct_q40.m?download=true"],
+        f"{_HF}/Llama-3_1-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_tokenizer_llama_3_1.t?download=true",
+    ),
+    "llama3_1_405b_instruct_q40": (
+        [f"{_HF}/Llama-3_1-405B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_model_llama31_405b_q40_{i}.m?download=true" for i in range(56)],
+        f"{_HF}/Llama-3_1-405B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_tokenizer_llama_3_1.t?download=true",
+    ),
+    "llama3_2_1b_instruct_q40": (
+        [f"{_HF}/Llama-3_2-1B-Instruct-Q40-Distributed-Llama/resolve/main/dllama_model_llama3.2-1b-instruct_q40.m?download=true"],
+        f"{_HF}/Llama-3_2-1B-Instruct-Q40-Distributed-Llama/resolve/main/dllama_tokenizer_llama3_2.t?download=true",
+    ),
+    "llama3_2_3b_instruct_q40": (
+        [f"{_HF}/Llama-3_2-3B-Instruct-Q40-Distributed-Llama/resolve/main/dllama_model_llama3.2-3b-instruct_q40.m?download=true"],
+        f"{_HF}/Llama-3_2-3B-Instruct-Q40-Distributed-Llama/resolve/main/dllama_tokenizer_llama3_2.t?download=true",
+    ),
+    "llama3_3_70b_instruct_q40": (
+        [f"{_HF}/Llama-3_3-70B-Instruct-Q40-Distributed-Llama/resolve/main/dllama_model_llama-3.3-70b_q40{s}.m?download=true" for s in ("", *(f"_{i}" for i in range(1, 11)))],
+        f"{_HF}/Llama-3_3-70B-Instruct-Q40-Distributed-Llama/resolve/main/dllama_tokenizer_llama_3_3.t?download=true",
+    ),
+    "deepseek_r1_distill_llama_8b_q40": (
+        [f"{_HF}/DeepSeek-R1-Distill-Llama-8B-Distributed-Llama/resolve/main/dllama_model_deepseek-r1-distill-llama-8b_q40.m?download=true"],
+        f"{_HF}/DeepSeek-R1-Distill-Llama-8B-Distributed-Llama/resolve/main/dllama_tokenizer_deepseek-r1-distill-llama-8b.t?download=true",
+    ),
+}
+
+CHUNK = 1 << 20
+
+
+def download(url: str, path: str) -> None:
+    """Resumable chunked download."""
+    done = os.path.getsize(path) if os.path.exists(path) else 0
+    req = urllib.request.Request(url)
+    if done:
+        req.add_header("Range", f"bytes={done}-")
+    try:
+        with urllib.request.urlopen(req) as r:
+            total = done + int(r.headers.get("Content-Length", 0))
+            mode = "ab" if done and r.status == 206 else "wb"
+            with open(path, mode) as f:
+                while True:
+                    chunk = r.read(CHUNK)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    done += len(chunk)
+                    pct = 100 * done / total if total else 0
+                    print(f"\r📀 {os.path.basename(path)}: {done >> 20} MB ({pct:.0f}%)", end="", flush=True)
+    except urllib.error.HTTPError as e:
+        if e.code == 416:  # already complete
+            return
+        raise
+    print()
+
+
+def fetch_model(name: str) -> tuple[str, str]:
+    model_urls, tok_url = CATALOG[name]
+    d = os.path.join("models", name)
+    os.makedirs(d, exist_ok=True)
+    model_path = os.path.join(d, f"dllama_model_{name}.m")
+    tok_path = os.path.join(d, f"dllama_tokenizer_{name}.t")
+    if not os.path.exists(model_path):
+        parts = []
+        for i, url in enumerate(model_urls):
+            part = model_path + (f".part{i}" if len(model_urls) > 1 else "")
+            download(url, part)
+            parts.append(part)
+        if len(parts) > 1:
+            with open(model_path, "wb") as out:
+                for p in parts:
+                    with open(p, "rb") as f:
+                        while True:
+                            b = f.read(CHUNK)
+                            if not b:
+                                break
+                            out.write(b)
+                    os.remove(p)
+        elif parts[0] != model_path:
+            os.rename(parts[0], model_path)
+    if not os.path.exists(tok_path):
+        download(tok_url, tok_path)
+    return model_path, tok_path
+
+
+def write_run_script(name: str, model: str, tokenizer: str) -> str:
+    path = f"run_{name}.sh"
+    with open(path, "w") as f:
+        f.write(
+            "#!/bin/sh\n"
+            f"python -m distributed_llama_multiusers_tpu.app.dllama chat \\\n"
+            f"  --model {model} \\\n"
+            f"  --tokenizer {tokenizer} \\\n"
+            f"  --temperature 0.7 --topp 0.9 --max-seq-len 4096\n"
+        )
+    os.chmod(path, 0o755)
+    return path
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in CATALOG:
+        print("Usage: python launch.py <model> [--run chat|api]")
+        print("Available models:")
+        for name in CATALOG:
+            print(f"  {name}")
+        raise SystemExit(0 if len(sys.argv) < 2 else 1)
+    name = sys.argv[1]
+    model, tokenizer = fetch_model(name)
+    script = write_run_script(name, model, tokenizer)
+    print(f"✅ {script} written")
+    if "--run" in sys.argv:
+        mode = sys.argv[sys.argv.index("--run") + 1] if sys.argv.index("--run") + 1 < len(sys.argv) else "chat"
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        if mode == "api":
+            from distributed_llama_multiusers_tpu.app.dllama_api import main as api_main
+
+            api_main(["--model", model, "--tokenizer", tokenizer])
+        else:
+            from distributed_llama_multiusers_tpu.app.dllama import main as cli_main
+
+            cli_main(["chat", "--model", model, "--tokenizer", tokenizer])
+
+
+if __name__ == "__main__":
+    main()

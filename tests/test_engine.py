@@ -193,3 +193,30 @@ def test_request_cancellation_frees_lane(stack):
         assert isinstance(req2.future.result(timeout=60), str)
     finally:
         sched.stop()
+
+
+def test_stop_resolves_inflight_futures(stack):
+    """Shutdown mid-generation must resolve futures, not hang clients."""
+    config, engine, tok, _ = stack
+    sched = ContinuousBatchingScheduler(engine, tok)
+    sched.start()
+    req = sched.submit(Request(prompt="hello world", max_tokens=1000, temperature=0.0))
+    while req.state.name != "GENERATING" and not req.future.done():
+        time.sleep(0.01)
+    sched.stop()
+    # future resolves (with partial text), no hang
+    assert isinstance(req.future.result(timeout=10), str)
+    assert req.finish_reason == "cancelled"
+
+
+def test_empty_prompt_fails_cleanly(stack):
+    config, engine, tok, _ = stack
+    sched = ContinuousBatchingScheduler(engine, tok)
+    sched.start()
+    try:
+        req = sched.submit(Request(prompt="", max_tokens=4, add_bos=False, temperature=0.0))
+        with pytest.raises(Exception) as e:
+            req.future.result(timeout=30)
+        assert "empty prompt" in str(e.value) or "at least one token" in str(e.value)
+    finally:
+        sched.stop()

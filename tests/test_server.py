@@ -138,3 +138,15 @@ def test_cors_preflight(server):
     with urllib.request.urlopen(req, timeout=30) as r:
         assert r.status == 204
         assert r.headers["Access-Control-Allow-Origin"] == "*"
+
+
+def test_streaming_bad_request_gets_400(server):
+    """Validation must happen before SSE headers commit."""
+    req = urllib.request.Request(
+        server + "/v1/chat/completions",
+        data=json.dumps({"stream": True}).encode(),  # no messages
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400

@@ -1,0 +1,69 @@
+// Minimal chat client for the dllama-api server (reference: web-ui/app.js —
+// reads the fork's `generated_text`; this one streams via SSE and falls back
+// to the non-streaming field).
+const API = (location.search.match(/api=([^&]+)/) || [])[1] || "http://localhost:9990";
+const log = document.getElementById("log");
+const form = document.getElementById("form");
+const input = document.getElementById("input");
+const send = document.getElementById("send");
+const status = document.getElementById("status");
+const history = [];
+
+fetch(`${API}/v1/models`).then(r => r.json())
+  .then(d => { status.textContent = `model: ${d.data[0].id} @ ${API}`; })
+  .catch(() => { status.textContent = `server not reachable at ${API}`; });
+
+function bubble(cls, text) {
+  const div = document.createElement("div");
+  div.className = `msg ${cls}`;
+  div.textContent = text;
+  log.appendChild(div);
+  div.scrollIntoView();
+  return div;
+}
+
+form.addEventListener("submit", async (e) => {
+  e.preventDefault();
+  const text = input.value.trim();
+  if (!text) return;
+  input.value = "";
+  send.disabled = true;
+  bubble("user", text);
+  history.push({ role: "user", content: text });
+  const out = bubble("assistant", "…");
+  try {
+    const resp = await fetch(`${API}/v1/chat/completions`, {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ messages: history, max_tokens: 256, temperature: 0.7, stream: true }),
+    });
+    const reader = resp.body.getReader();
+    const decoder = new TextDecoder();
+    let buf = "", full = "";
+    out.textContent = "";
+    for (;;) {
+      const { done, value } = await reader.read();
+      if (done) break;
+      buf += decoder.decode(value, { stream: true });
+      let idx;
+      while ((idx = buf.indexOf("\n\n")) >= 0) {
+        const line = buf.slice(0, idx).trim();
+        buf = buf.slice(idx + 2);
+        if (!line.startsWith("data: ")) continue;
+        const payload = line.slice(6);
+        if (payload === "[DONE]") continue;
+        const obj = JSON.parse(payload);
+        if (obj.generated_text !== undefined) full = obj.generated_text;
+        const delta = obj.choices?.[0]?.delta?.content;
+        if (delta) { full += delta; out.textContent = full; out.scrollIntoView(); }
+      }
+    }
+    out.textContent = full || out.textContent;
+    history.push({ role: "assistant", content: full });
+  } catch (err) {
+    out.textContent = `error: ${err}`;
+  } finally {
+    send.disabled = false;
+    input.focus();
+  }
+});
