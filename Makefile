@@ -1,0 +1,20 @@
+# Build targets (reference: Makefile — here the compute path is XLA-compiled
+# at runtime; native builds cover the C++ host components).
+
+NATIVE_DIR := distributed_llama_multiusers_tpu/native
+NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
+
+.PHONY: all native test clean
+
+all: native
+
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): $(NATIVE_DIR)/quant_codec.cpp
+	python -c "from distributed_llama_multiusers_tpu.native import ensure_built; import sys; sys.exit(0 if ensure_built(quiet=False) else 1)"
+
+test: native
+	python -m pytest tests/ -x -q
+
+clean:
+	rm -f $(NATIVE_SO)

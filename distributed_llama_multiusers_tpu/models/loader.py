@@ -21,14 +21,21 @@ from .llama import LlamaLayerParams, LlamaParams
 
 
 def _decode_tensor(raw: np.ndarray, float_type: int, shape: tuple[int, int]) -> np.ndarray:
+    from .. import native
+
     if float_type == FloatType.F32:
         x = raw.view("<f4").astype(np.float32)
     elif float_type == FloatType.F16:
         x = raw.view("<f2").astype(np.float32)
     elif float_type == FloatType.Q40:
-        x = dequantize_q40(raw)
+        # threaded C++ dequant when built (native/quant_codec.cpp), numpy else
+        x = native.dequantize_q40(raw)
+        if x is None:
+            x = dequantize_q40(raw)
     elif float_type == FloatType.Q80:
-        x = dequantize_q80(raw)
+        x = native.dequantize_q80(raw)
+        if x is None:
+            x = dequantize_q80(raw)
     else:
         raise ValueError(f"unsupported float type {float_type}")
     return np.ascontiguousarray(x.reshape(shape))

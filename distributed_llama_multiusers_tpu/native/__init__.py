@@ -1,0 +1,162 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference implements its host-side runtime (quant codecs, weight
+splitting, mmap IO) in C++ (src/nn/nn-quants.cpp, src/mmap.hpp); this package
+provides the TPU framework's equivalents. The shared library is built by the
+repo Makefile (`make native`) or on demand by :func:`ensure_built`; every
+consumer falls back to the numpy codecs when the library is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_DIR, "libdllama_native.so")
+_SRC = os.path.join(_DIR, "quant_codec.cpp")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+# single source of truth for the build line; the Makefile target shells out
+# to this module so the two paths cannot drift
+BUILD_FLAGS = ["-O3", "-march=native", "-shared", "-fPIC", "-std=c++17"]
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Compile the shared library if missing/stale (g++). Returns success.
+    Compiles to a per-pid temp file then renames, so concurrent first runs
+    cannot corrupt the .so."""
+    try:
+        if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC):
+            return True
+    except OSError:
+        # source missing: usable iff a prebuilt .so is loadable
+        return os.path.exists(_SO_PATH)
+    tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", *BUILD_FLAGS, "-o", tmp, _SRC, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=quiet)
+        os.replace(tmp, _SO_PATH)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if not ensure_built():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _load_failed = True
+            return None
+        c_f32p = ctypes.POINTER(ctypes.c_float)
+        c_u8p = ctypes.POINTER(ctypes.c_uint8)
+        c_i8p = ctypes.POINTER(ctypes.c_int8)
+        c_u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.dlq_q40_quantize.argtypes = [c_f32p, c_u8p, ctypes.c_int64, ctypes.c_int]
+        lib.dlq_q40_dequantize.argtypes = [c_u8p, c_f32p, ctypes.c_int64, ctypes.c_int]
+        lib.dlq_q40_to_planar.argtypes = [c_u8p, c_i8p, c_f32p, ctypes.c_int64, ctypes.c_int]
+        lib.dlq_q80_quantize.argtypes = [c_f32p, c_u8p, ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+        lib.dlq_q80_dequantize.argtypes = [c_u8p, c_f32p, ctypes.c_int64, ctypes.c_int]
+        lib.dlq_f16_to_f32.argtypes = [c_u16p, c_f32p, ctypes.c_int64, ctypes.c_int]
+        lib.dlq_f32_to_f16.argtypes = [c_f32p, c_u16p, ctypes.c_int64, ctypes.c_int]
+        lib.dlq_abi_version.restype = ctypes.c_int
+        if lib.dlq_abi_version() != 1:
+            _load_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+def _threads() -> int:
+    return min(os.cpu_count() or 1, 16)
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def quantize_q40(x: np.ndarray) -> np.ndarray | None:
+    lib = load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    assert x.size % 32 == 0
+    n_blocks = x.size // 32
+    out = np.empty((n_blocks, 18), np.uint8)
+    lib.dlq_q40_quantize(_ptr(x, ctypes.c_float), _ptr(out, ctypes.c_uint8), n_blocks, _threads())
+    return out
+
+
+def dequantize_q40(blocks: np.ndarray) -> np.ndarray | None:
+    lib = load()
+    if lib is None:
+        return None
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8).reshape(-1, 18)
+    out = np.empty(blocks.shape[0] * 32, np.float32)
+    lib.dlq_q40_dequantize(_ptr(blocks, ctypes.c_uint8), _ptr(out, ctypes.c_float), blocks.shape[0], _threads())
+    return out
+
+
+def q40_to_planar(blocks: np.ndarray):
+    lib = load()
+    if lib is None:
+        return None
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8).reshape(-1, 18)
+    n = blocks.shape[0]
+    values = np.empty((n, 32), np.int8)
+    scales = np.empty(n, np.float32)
+    lib.dlq_q40_to_planar(
+        _ptr(blocks, ctypes.c_uint8), _ptr(values, ctypes.c_int8), _ptr(scales, ctypes.c_float), n, _threads()
+    )
+    return values, scales
+
+
+def quantize_q80(x: np.ndarray, mode: str = "runtime") -> np.ndarray | None:
+    lib = load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    assert x.size % 32 == 0
+    n_blocks = x.size // 32
+    out = np.empty((n_blocks, 34), np.uint8)
+    lib.dlq_q80_quantize(
+        _ptr(x, ctypes.c_float), _ptr(out, ctypes.c_uint8), n_blocks,
+        1 if mode == "converter" else 0, _threads(),
+    )
+    return out
+
+
+def dequantize_q80(blocks: np.ndarray) -> np.ndarray | None:
+    lib = load()
+    if lib is None:
+        return None
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8).reshape(-1, 34)
+    out = np.empty(blocks.shape[0] * 32, np.float32)
+    lib.dlq_q80_dequantize(_ptr(blocks, ctypes.c_uint8), _ptr(out, ctypes.c_float), blocks.shape[0], _threads())
+    return out

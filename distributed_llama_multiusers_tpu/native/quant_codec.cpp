@@ -1,0 +1,230 @@
+// Native Q40/Q80 block codec — the C++ runtime component backing the host
+// quantization path (counterpart of the reference's src/nn/nn-quants.cpp,
+// re-implemented: same on-disk format, fresh code).
+//
+// Semantics are bit-exact with quants/codec.py:
+//   Q40: 32-elt block, fp16 scale d = signed_absmax / -8,
+//        q = clip(trunc(x/d + 8.5), 0, 15), low nibbles = elts [0,16)
+//   Q80: 32-elt block, fp16 scale d = absmax / 127,
+//        q = round(x/d)  (ties-away "runtime" or ties-even "converter")
+// fp16 conversion is IEEE round-to-nearest-even.
+//
+// Exposed as a C ABI for ctypes; all entry points release the GIL by
+// construction (pure C, no Python API). Multi-threaded over blocks.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kBlock = 32;
+constexpr int kQ40Bytes = 18; // 2B f16 scale + 16 nibble bytes
+constexpr int kQ80Bytes = 34; // 2B f16 scale + 32 int8
+
+inline uint16_t f32_to_f16(float f) {
+    uint32_t x;
+    std::memcpy(&x, &f, 4);
+    const uint32_t sign = (x >> 16) & 0x8000u;
+    uint32_t mant = x & 0x007FFFFFu;
+    const uint32_t exp_bits = (x >> 23) & 0xFFu;
+    const int32_t exp = (int32_t)exp_bits - 127 + 15;
+    if (exp_bits == 0xFF) // inf / nan
+        return (uint16_t)(sign | 0x7C00u | (mant ? 0x200u : 0u));
+    if (exp >= 31) // overflow -> inf
+        return (uint16_t)(sign | 0x7C00u);
+    if (exp <= 0) {
+        if (exp < -10)
+            return (uint16_t)sign;
+        mant |= 0x00800000u;
+        const uint32_t shift = (uint32_t)(14 - exp);
+        uint32_t half = mant >> shift;
+        const uint32_t rem = mant & ((1u << shift) - 1u);
+        const uint32_t halfway = 1u << (shift - 1);
+        if (rem > halfway || (rem == halfway && (half & 1u)))
+            half++;
+        return (uint16_t)(sign | half);
+    }
+    uint32_t out = sign | ((uint32_t)exp << 10) | (mant >> 13);
+    const uint32_t rem = mant & 0x1FFFu;
+    if (rem > 0x1000u || (rem == 0x1000u && (out & 1u)))
+        out++;
+    return (uint16_t)out;
+}
+
+inline float f16_to_f32(uint16_t h) {
+    const uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1Fu;
+    uint32_t mant = h & 0x3FFu;
+    uint32_t x;
+    if (exp == 0) {
+        if (mant == 0) {
+            x = sign;
+        } else { // subnormal
+            exp = 127 - 15 + 1;
+            while (!(mant & 0x400u)) {
+                mant <<= 1;
+                exp--;
+            }
+            mant &= 0x3FFu;
+            x = sign | (exp << 23) | (mant << 13);
+        }
+    } else if (exp == 31) {
+        x = sign | 0x7F800000u | (mant << 13);
+    } else {
+        x = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+    }
+    float f;
+    std::memcpy(&f, &x, 4);
+    return f;
+}
+
+template <typename Fn>
+void parallel_blocks(int64_t n_blocks, int n_threads, Fn fn) {
+    if (n_threads <= 1 || n_blocks < 1024) {
+        fn(0, n_blocks);
+        return;
+    }
+    std::vector<std::thread> threads;
+    const int64_t per = (n_blocks + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; t++) {
+        const int64_t lo = t * per;
+        const int64_t hi = std::min(n_blocks, lo + per);
+        if (lo >= hi)
+            break;
+        threads.emplace_back([=] { fn(lo, hi); });
+    }
+    for (auto &th : threads)
+        th.join();
+}
+
+} // namespace
+
+extern "C" {
+
+// x: n_blocks*32 floats -> out: n_blocks*18 bytes
+void dlq_q40_quantize(const float *x, uint8_t *out, int64_t n_blocks, int n_threads) {
+    parallel_blocks(n_blocks, n_threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t b = lo; b < hi; b++) {
+            const float *p = x + b * kBlock;
+            uint8_t *o = out + b * kQ40Bytes;
+            // tie-break must match the numpy codec (and converter/writer.py):
+            // when -min == max, the POSITIVE extreme wins
+            float gmin = p[0], gmax = p[0];
+            for (int j = 1; j < kBlock; j++) {
+                gmin = std::min(gmin, p[j]);
+                gmax = std::max(gmax, p[j]);
+            }
+            const float maxv = (-gmin > gmax) ? gmin : gmax;
+            const float d = maxv / -8.0f;
+            const float id = d != 0.0f ? 1.0f / d : 0.0f;
+            const uint16_t d16 = f32_to_f16(d);
+            std::memcpy(o, &d16, 2);
+            for (int j = 0; j < kBlock / 2; j++) {
+                float q0 = p[j] * id + 8.5f;
+                float q1 = p[j + kBlock / 2] * id + 8.5f;
+                int i0 = (int)std::min(std::max(q0, 0.0f), 15.0f);
+                int i1 = (int)std::min(std::max(q1, 0.0f), 15.0f);
+                o[2 + j] = (uint8_t)((i0 & 0xF) | ((i1 & 0xF) << 4));
+            }
+        }
+    });
+}
+
+void dlq_q40_dequantize(const uint8_t *in, float *out, int64_t n_blocks, int n_threads) {
+    parallel_blocks(n_blocks, n_threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t b = lo; b < hi; b++) {
+            const uint8_t *p = in + b * kQ40Bytes;
+            float *o = out + b * kBlock;
+            uint16_t d16;
+            std::memcpy(&d16, p, 2);
+            const float d = f16_to_f32(d16);
+            for (int j = 0; j < kBlock / 2; j++) {
+                const uint8_t byte = p[2 + j];
+                o[j] = (float)((int)(byte & 0x0F) - 8) * d;
+                o[j + kBlock / 2] = (float)((int)(byte >> 4) - 8) * d;
+            }
+        }
+    });
+}
+
+// planar decode for on-device use: int8 values [-8,7]+..., f32 scales
+void dlq_q40_to_planar(const uint8_t *in, int8_t *values, float *scales, int64_t n_blocks, int n_threads) {
+    parallel_blocks(n_blocks, n_threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t b = lo; b < hi; b++) {
+            const uint8_t *p = in + b * kQ40Bytes;
+            int8_t *v = values + b * kBlock;
+            uint16_t d16;
+            std::memcpy(&d16, p, 2);
+            scales[b] = f16_to_f32(d16);
+            for (int j = 0; j < kBlock / 2; j++) {
+                const uint8_t byte = p[2 + j];
+                v[j] = (int8_t)((int)(byte & 0x0F) - 8);
+                v[j + kBlock / 2] = (int8_t)((int)(byte >> 4) - 8);
+            }
+        }
+    });
+}
+
+// ties_even != 0 -> converter mode (rint, round-half-even);
+// ties_even == 0 -> runtime mode (roundf, half away from zero)
+void dlq_q80_quantize(const float *x, uint8_t *out, int64_t n_blocks, int ties_even, int n_threads) {
+    parallel_blocks(n_blocks, n_threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t b = lo; b < hi; b++) {
+            const float *p = x + b * kBlock;
+            uint8_t *o = out + b * kQ80Bytes;
+            float amax = 0.0f;
+            for (int j = 0; j < kBlock; j++)
+                amax = std::max(amax, std::fabs(p[j]));
+            const float d = amax / 127.0f;
+            const float id = d != 0.0f ? 1.0f / d : 0.0f;
+            const uint16_t d16 = f32_to_f16(d);
+            std::memcpy(o, &d16, 2);
+            int8_t *q = (int8_t *)(o + 2);
+            if (ties_even) {
+                for (int j = 0; j < kBlock; j++)
+                    q[j] = (int8_t)std::rint(p[j] * id);
+            } else {
+                for (int j = 0; j < kBlock; j++)
+                    q[j] = (int8_t)std::roundf(p[j] * id);
+            }
+        }
+    });
+}
+
+void dlq_q80_dequantize(const uint8_t *in, float *out, int64_t n_blocks, int n_threads) {
+    parallel_blocks(n_blocks, n_threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t b = lo; b < hi; b++) {
+            const uint8_t *p = in + b * kQ80Bytes;
+            float *o = out + b * kBlock;
+            uint16_t d16;
+            std::memcpy(&d16, p, 2);
+            const float d = f16_to_f32(d16);
+            const int8_t *q = (const int8_t *)(p + 2);
+            for (int j = 0; j < kBlock; j++)
+                o[j] = (float)q[j] * d;
+        }
+    });
+}
+
+// f16 <-> f32 array converters (counterpart of convertF16toF32Impl et al.)
+void dlq_f16_to_f32(const uint16_t *in, float *out, int64_t n, int n_threads) {
+    parallel_blocks(n, n_threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; i++)
+            out[i] = f16_to_f32(in[i]);
+    });
+}
+
+void dlq_f32_to_f16(const float *in, uint16_t *out, int64_t n, int n_threads) {
+    parallel_blocks(n, n_threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; i++)
+            out[i] = f32_to_f16(in[i]);
+    });
+}
+
+int dlq_abi_version(void) { return 1; }
+
+} // extern "C"

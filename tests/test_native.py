@@ -1,0 +1,128 @@
+"""Native C++ codec must be byte-exact with the numpy reference codecs."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_multiusers_tpu import native
+from distributed_llama_multiusers_tpu.quants import codec
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not native.available():
+        pytest.skip("native library unavailable (no g++?)")
+
+
+def rand(n, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n, dtype=np.float32) * scale).astype(np.float32)
+
+
+def edge_values():
+    """Blocks hitting f16 rounding edges, zeros, tiny/huge magnitudes."""
+    x = np.zeros(32 * 6, np.float32)
+    x[32:64] = rand(32, 1, 1e-6)      # subnormal f16 scales
+    x[64:96] = rand(32, 2, 1e4)       # large
+    x[96] = 127.0
+    x[97] = 0.5
+    x[98] = -0.5
+    x[128:160] = rand(32, 3, 65504.0)  # f16 max territory
+    x[160:192] = rand(32, 4)
+    return x
+
+
+@pytest.mark.parametrize("maker", [lambda: rand(32 * 1000, 7), edge_values])
+def test_q40_quantize_byte_exact(maker):
+    x = maker()
+    a = native.quantize_q40(x)
+    b = codec.quantize_q40(x)
+    assert a.tobytes() == b.tobytes()
+
+
+@pytest.mark.parametrize("mode", ["runtime", "converter"])
+def test_q80_quantize_byte_exact(mode):
+    x = np.concatenate([rand(32 * 1000, 8), edge_values()])
+    a = native.quantize_q80(x, mode=mode)
+    b = codec.quantize_q80(x, mode=mode)
+    assert a.tobytes() == b.tobytes()
+
+
+def test_q40_dequantize_bit_exact():
+    x = rand(32 * 500, 9)
+    blocks = codec.quantize_q40(x)
+    a = native.dequantize_q40(blocks)
+    b = codec.dequantize_q40(blocks)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_q80_dequantize_bit_exact():
+    x = rand(32 * 500, 10)
+    blocks = codec.quantize_q80(x)
+    a = native.dequantize_q80(blocks)
+    b = codec.dequantize_q80(blocks)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_q40_planar_matches():
+    x = rand(32 * 200, 11)
+    blocks = codec.quantize_q40(x)
+    va, sa = native.q40_to_planar(blocks)
+    vb, sb = codec.q40_to_planar(blocks)
+    np.testing.assert_array_equal(va, vb)
+    np.testing.assert_array_equal(sa, sb)
+
+
+def test_f16_conversion_matches_numpy():
+    import ctypes
+
+    lib = native.load()
+    # every possible f16 bit pattern decodes exactly like numpy
+    h = np.arange(65536, dtype=np.uint16)
+    out = np.empty(65536, np.float32)
+    lib.dlq_f16_to_f32(
+        h.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        65536, 1,
+    )
+    expect = h.view(np.float16).astype(np.float32)
+    np.testing.assert_array_equal(np.nan_to_num(out, nan=0), np.nan_to_num(expect, nan=0))
+    assert np.array_equal(np.isnan(out), np.isnan(expect))
+    # f32 -> f16 round-trips bit-exactly vs numpy cast on random values
+    f = np.concatenate([rand(10000, 12, s) for s in (1.0, 1e-5, 1e5)]).astype(np.float32)
+    got = np.empty(f.size, np.uint16)
+    lib.dlq_f32_to_f16(
+        f.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        got.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        f.size, 1,
+    )
+    np.testing.assert_array_equal(got, f.astype(np.float16).view(np.uint16))
+
+
+def test_loader_uses_native_and_matches(tiny_model):
+    """Loading through the native dequant path equals pure-numpy loading."""
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.formats import load_model_header
+    from distributed_llama_multiusers_tpu.models.loader import read_m_tensors
+
+    h = load_model_header(tiny_model["model"])
+    with_native = read_m_tensors(tiny_model["model"], h)
+    # force numpy fallback
+    saved = native._lib, native._load_failed
+    native._lib, native._load_failed = None, True
+    try:
+        without = read_m_tensors(tiny_model["model"], h)
+    finally:
+        native._lib, native._load_failed = saved
+    np.testing.assert_array_equal(with_native["wq"][0], without["wq"][0])
+    np.testing.assert_array_equal(with_native["embedding"], without["embedding"])
+
+
+def test_q40_tie_break_matches_numpy():
+    """-min == max tie must pick the positive extreme (writer.py semantics)."""
+    x = np.zeros(32, np.float32)
+    x[0] = -3.0
+    x[1] = 3.0
+    a = native.quantize_q40(x)
+    b = codec.quantize_q40(x)
+    assert a.tobytes() == b.tobytes()
