@@ -1,3 +1,8 @@
 from .config import LlamaConfig
 from .llama import LlamaParams, llama_forward, llama_forward_train, init_kv_cache
-from .loader import load_params_from_m, params_from_random
+from .loader import (
+    load_params_from_m,
+    load_params_from_m_quantized,
+    params_from_random,
+    quantize_params,
+)

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from ..formats.model_file import HiddenAct
 from ..ops.activations import gelu, silu
+from ..ops.linear import matmul
 from ..ops.norm import rms_norm
 from ..ops.rope import apply_rope
 from .config import LlamaConfig
@@ -37,7 +38,9 @@ class LlamaLayerParams(NamedTuple):
     """Per-layer weights, stacked along a leading [n_layers] axis.
 
     Matmul weights are stored [d_in, d_out] so that y = x @ W (the .m file
-    stores the transpose, [d_out, d_in]; the loader transposes once).
+    stores the transpose, [d_out, d_in]; the loader transposes once). Each
+    matmul field holds either a dense array or a ``PackedQ40`` (weights kept
+    quantized in HBM, dequantized inside the matmul — ops/linear.py).
     """
 
     wq: jnp.ndarray  # [L, dim, dim]
@@ -112,9 +115,9 @@ def llama_forward(
 
         y = rms_norm(x, lp.rms_att, eps)
         yq = maybe_qdq(y)
-        q = (yq @ lp.wq).reshape(b, t, n_heads, hd)
-        k = (yq @ lp.wk).reshape(b, t, n_kv, hd)
-        v = (yq @ lp.wv).reshape(b, t, n_kv, hd)
+        q = matmul(yq, lp.wq).reshape(b, t, n_heads, hd)
+        k = matmul(yq, lp.wk).reshape(b, t, n_kv, hd)
+        v = matmul(yq, lp.wv).reshape(b, t, n_kv, hd)
 
         q = apply_rope(q, params.rope_cos, params.rope_sin, positions)
         k = apply_rope(k, params.rope_cos, params.rope_sin, positions)
@@ -134,14 +137,14 @@ def llama_forward(
         attn = jnp.einsum("btkgs,bskh->btkgh", probs, vf)
         attn = attn.reshape(b, t, n_heads * hd).astype(dtype)
 
-        out = maybe_qdq(attn) @ lp.wo
+        out = matmul(maybe_qdq(attn), lp.wo)
         x = x + maybe_qdq(out)  # sync-boundary cast (ZQ pipe) + merge_add
 
         y = rms_norm(x, lp.rms_ffn, eps)
         yq = maybe_qdq(y)
-        g = act_fn(yq @ lp.w1)
-        u = yq @ lp.w3
-        d = maybe_qdq(g * u) @ lp.w2
+        g = act_fn(matmul(yq, lp.w1))
+        u = matmul(yq, lp.w3)
+        d = matmul(maybe_qdq(g * u), lp.w2)
         x = x + maybe_qdq(d)
 
         return x, (k_cache, v_cache)
@@ -149,7 +152,7 @@ def llama_forward(
     x, (new_k, new_v) = jax.lax.scan(layer_step, x, (params.layers, cache.k, cache.v))
 
     y = rms_norm(x, params.rms_final, eps)
-    logits = (maybe_qdq(y) @ params.wcls).astype(jnp.float32)  # [B, T, vocab]
+    logits = matmul(maybe_qdq(y), params.wcls).astype(jnp.float32)  # [B, T, vocab]
     return logits, KVCache(k=new_k, v=new_v)
 
 
@@ -173,9 +176,9 @@ def llama_forward_train(
     def layer_step(x, lp):
         dtype = x.dtype
         y = rms_norm(x, lp.rms_att, eps)
-        q = (y @ lp.wq).reshape(b, t, n_heads, hd)
-        k = (y @ lp.wk).reshape(b, t, n_kv, hd)
-        v = (y @ lp.wv).reshape(b, t, n_kv, hd)
+        q = matmul(y, lp.wq).reshape(b, t, n_heads, hd)
+        k = matmul(y, lp.wk).reshape(b, t, n_kv, hd)
+        v = matmul(y, lp.wv).reshape(b, t, n_kv, hd)
         q = apply_rope(q, params.rope_cos, params.rope_sin, positions)
         k = apply_rope(k, params.rope_cos, params.rope_sin, positions)
 
@@ -187,12 +190,12 @@ def llama_forward_train(
         scores = jnp.where(causal[:, None, None, :], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("btkgs,bskh->btkgh", probs, vf).reshape(b, t, n_heads * hd)
-        x = x + (attn.astype(dtype) @ lp.wo)
+        x = x + matmul(attn.astype(dtype), lp.wo)
 
         y = rms_norm(x, lp.rms_ffn, eps)
-        x = x + (act_fn(y @ lp.w1) * (y @ lp.w3)) @ lp.w2
+        x = x + matmul(act_fn(matmul(y, lp.w1)) * matmul(y, lp.w3), lp.w2)
         return x, None
 
     x, _ = jax.lax.scan(layer_step, x, params.layers)
     y = rms_norm(x, params.rms_final, eps)
-    return (y @ params.wcls).astype(jnp.float32)
+    return matmul(y, params.wcls).astype(jnp.float32)

@@ -16,6 +16,11 @@ import jax.numpy as jnp
 from ..formats.model_file import ModelHeader, iter_model_tensors
 from ..ops.rope import build_rope_cache
 from ..quants.codec import FloatType, dequantize_q40, dequantize_q80
+from ..quants.packed import (
+    PackedQ40,
+    pack_q40_from_blocks,
+    pack_q40_host,
+)
 from .config import LlamaConfig
 from .llama import LlamaLayerParams, LlamaParams
 
@@ -137,6 +142,110 @@ def load_params_from_m(
         rope_sin=put("rope_sin", sin),
     )
     return config, params
+
+
+_MATMUL_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def load_params_from_m_quantized(
+    path: str,
+    header: ModelHeader,
+    dtype=jnp.bfloat16,
+    device_put_fn=None,
+) -> tuple[LlamaConfig, LlamaParams]:
+    """Load a Q40 .m keeping matmul weights quantized on device (PackedQ40:
+    int4 nibbles + f16 block scales, quants/packed.py) — the TPU equivalent of
+    the reference running Q40 weights at rest (src/nn/nn-cpu-ops.cpp:222-440).
+    Non-Q40 matmul tensors (f32/f16 models) are loaded dense; embedding and
+    norms are always dense (gather/elementwise ops want plain arrays)."""
+    config = LlamaConfig.from_header(header)
+    put = device_put_fn or (lambda name, x: jnp.asarray(x))
+    L = config.n_layers
+
+    dense: dict = {}
+    packed_w: dict = {k: [None] * L for k in _MATMUL_KEYS}
+    for spec, raw in iter_model_tensors(path, header):
+        is_matmul = spec.name.startswith("block_matmul_") or spec.name == "final_matmul_logits"
+        if is_matmul and spec.float_type == FloatType.Q40:
+            pk, sc = pack_q40_from_blocks(raw, spec.shape)
+            if spec.name == "final_matmul_logits":
+                dense["wcls"] = ("q40", pk, sc)
+            else:
+                key = _TENSOR_NAME_MAP[spec.name]
+                packed_w[key][spec.layer] = (pk, sc)
+        else:
+            x = _decode_tensor(raw, spec.float_type, spec.shape)
+            if spec.name == "embedding":
+                dense["embedding"] = x
+            elif spec.name == "final_rms_norm":
+                dense["rms_final"] = x.reshape(-1)
+            elif spec.name == "final_matmul_logits":
+                dense["wcls"] = ("dense", x.T)
+            else:
+                key = _TENSOR_NAME_MAP[spec.name]
+                dense.setdefault(key, [None] * L)
+                dense[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
+
+    # host-cast before device_put where a numpy dtype exists (bf16 casts at
+    # put time) — same contract as load_params_from_m's cast()
+    np_dtype = np.dtype(jnp.dtype(dtype).name) if jnp.dtype(dtype) != jnp.bfloat16 else None
+
+    def cast(x: np.ndarray) -> np.ndarray:
+        return x if np_dtype is None else x.astype(np_dtype)
+
+    def stack_packed(key: str):
+        mats = packed_w[key]
+        if all(m is not None for m in mats):
+            return PackedQ40(
+                packed=put(key, np.stack([m[0] for m in mats])),
+                scales=put(key + ".scales", np.stack([m[1] for m in mats])),
+            )
+        # dense fallback (non-Q40 model): same path as load_params_from_m
+        return put(key, cast(np.stack([m.T for m in dense[key]]))).astype(dtype)
+
+    cos, sin = build_rope_cache(
+        config.seq_len,
+        config.head_size,
+        config.rope_theta,
+        config.rope_scaling_factor,
+        config.rope_scaling_low_freq_factor,
+        config.rope_scaling_high_freq_factor,
+        config.rope_scaling_orig_max_seq_len,
+    )
+    layers = LlamaLayerParams(
+        **{k: stack_packed(k) for k in _MATMUL_KEYS},
+        rms_att=put("rms_att", np.stack(dense["rms_att"])).astype(jnp.float32),
+        rms_ffn=put("rms_ffn", np.stack(dense["rms_ffn"])).astype(jnp.float32),
+    )
+    wcls_entry = dense["wcls"]
+    if wcls_entry[0] == "q40":
+        wcls = PackedQ40(packed=put("wcls", wcls_entry[1]), scales=put("wcls.scales", wcls_entry[2]))
+    else:
+        wcls = put("wcls", cast(wcls_entry[1])).astype(dtype)
+    params = LlamaParams(
+        embedding=put("embedding", cast(dense["embedding"])).astype(dtype),
+        layers=layers,
+        rms_final=put("rms_final", dense["rms_final"]).astype(jnp.float32),
+        wcls=wcls,
+        rope_cos=put("rope_cos", cos),
+        rope_sin=put("rope_sin", sin),
+    )
+    return config, params
+
+
+def quantize_params(params: LlamaParams) -> LlamaParams:
+    """Quantize a dense params pytree to PackedQ40 layer matmuls + wcls
+    (through the bit-exact Q40 encoder). Host-side; used by benchmarks and
+    tests so multi-GB Q40 files need not exist on disk."""
+
+    def q(w) -> PackedQ40:
+        # w: [L?, d_in, d_out] device/numpy array -> file orientation then pack
+        wf = np.asarray(jnp.swapaxes(jnp.asarray(w, jnp.float32), -1, -2))
+        pk, sc = pack_q40_host(wf)
+        return PackedQ40(packed=jnp.asarray(pk), scales=jnp.asarray(sc))
+
+    layers = params.layers._replace(**{k: q(getattr(params.layers, k)) for k in _MATMUL_KEYS})
+    return params._replace(layers=layers, wcls=q(params.wcls))
 
 
 def params_from_random(config: LlamaConfig, seed: int = 0, dtype=jnp.bfloat16, scale: float = 0.02) -> LlamaParams:

@@ -1,0 +1,49 @@
+"""Matmul dispatch: dense arrays or PackedQ40 weights, Pallas or XLA path.
+
+The reference routes every matmul through a per-(op, quant-signature) kernel
+registry (getCpuOpForward, src/nn/nn-cpu-ops.cpp:1315-1361); here the same
+seam is a single function — ``matmul(x, w)`` — that picks the dequant-in-VMEM
+Pallas kernel for quantized weights on TPU and a fused XLA fallback
+elsewhere (CPU tests, interpret mode).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from ..quants.packed import PackedQ40, q40_matmul_xla
+
+
+@lru_cache(maxsize=1)
+def _pallas_q40_matmul():
+    """The Pallas kernel entry, or None off-TPU / when disabled."""
+    if os.environ.get("DLLAMA_NO_PALLAS") == "1":
+        return None
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:  # no backend at all (e.g. misconfigured platform)
+        return None
+    if not on_tpu:
+        return None
+    try:
+        from .pallas_q40 import q40_matmul_pallas
+    except ImportError as e:
+        import warnings
+
+        warnings.warn(f"Pallas Q40 kernel unavailable, using XLA fallback: {e}")
+        return None
+    return q40_matmul_pallas
+
+
+def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
+    """y = x @ w for dense [.., d_in, d_out] arrays or PackedQ40 weights."""
+    if isinstance(w, PackedQ40):
+        kernel = _pallas_q40_matmul()
+        if kernel is not None:
+            return kernel(x, w)
+        return q40_matmul_xla(x, w)
+    return x @ w

@@ -23,22 +23,35 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.llama import KVCache, LlamaLayerParams, LlamaParams
+from ..quants.packed import PackedQ40
 
 
-def param_shardings(mesh: Mesh) -> LlamaParams:
-    """A LlamaParams-shaped pytree of NamedShardings."""
+def param_shardings(mesh: Mesh, params: LlamaParams | None = None) -> LlamaParams:
+    """A LlamaParams-shaped pytree of NamedShardings.
+
+    When ``params`` is given, PackedQ40 weights get a matching PackedQ40 of
+    shardings (both nibble and scale planes carry the same spec: row-sliced
+    weights shard d_out = the last axis of every plane; col-sliced weights
+    shard d_in = axis -2, where the packed/scale planes are d_in/2- and
+    d_in/32-rows of the same logical input range)."""
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    def w(field, *spec):
+        if params is not None and isinstance(field, PackedQ40):
+            return PackedQ40(packed=ns(*spec), scales=ns(*spec))
+        return ns(*spec)
+
+    lp = params.layers if params is not None else LlamaLayerParams(*[None] * 9)
     layers = LlamaLayerParams(
-        wq=ns(None, None, "tp"),
-        wk=ns(None, None, "tp"),
-        wv=ns(None, None, "tp"),
-        wo=ns(None, "tp", None),
-        w1=ns(None, None, "tp"),
-        w2=ns(None, "tp", None),
-        w3=ns(None, None, "tp"),
+        wq=w(lp.wq, None, None, "tp"),
+        wk=w(lp.wk, None, None, "tp"),
+        wv=w(lp.wv, None, None, "tp"),
+        wo=w(lp.wo, None, "tp", None),
+        w1=w(lp.w1, None, None, "tp"),
+        w2=w(lp.w2, None, "tp", None),
+        w3=w(lp.w3, None, None, "tp"),
         rms_att=ns(None, None),
         rms_ffn=ns(None, None),
     )
@@ -49,7 +62,7 @@ def param_shardings(mesh: Mesh) -> LlamaParams:
         layers=layers,
         rms_final=ns(None),
         # logits row-sliced across tp like final_matmul_logits (src/llm.cpp:420-432)
-        wcls=ns(None, "tp"),
+        wcls=w(params.wcls if params is not None else None, None, "tp"),
         rope_cos=ns(None, None),
         rope_sin=ns(None, None),
     )
@@ -77,6 +90,7 @@ def data_shardings(mesh: Mesh):
 def shard_params(params: LlamaParams, mesh: Mesh) -> LlamaParams:
     """Place a host-side params pytree onto the mesh with TP/DP shardings —
     the moment that replaces the reference's root-splits-and-ships-weights
-    protocol (NnRootWeightLoader, src/nn/nn-network.cpp:824-901)."""
-    shardings = param_shardings(mesh)
+    protocol (NnRootWeightLoader, src/nn/nn-network.cpp:824-901). Handles
+    dense and PackedQ40-quantized params alike."""
+    shardings = param_shardings(mesh, params)
     return jax.tree.map(jax.device_put, params, shardings)

@@ -13,3 +13,11 @@ from .codec import (
     tensor_bytes,
     float_type_name,
 )
+from .packed import (
+    PackedQ40,
+    pack_q40_from_blocks,
+    pack_q40_host,
+    pack_q40_planar,
+    q40_matmul_xla,
+    unpack_q40,
+)

@@ -1,0 +1,113 @@
+"""On-device packed Q40 weights: int4 nibbles + f16 block scales in HBM.
+
+The reference keeps Q40 weights quantized at rest and dequantizes inside the
+matmul kernel (src/nn/nn-cpu-ops.cpp:222-440 matmul_Q80_Q40_F32,
+src/nn/vulkan/matmul-forward-q80-q40-f32.comp); the bf16 loader path instead
+dequantizes on the host and ships 4x the bytes to HBM. Since TPU decode is
+HBM-bandwidth-bound, keeping weights at 4 bit + 1/32 f16 scale (~4.5 bits/
+element, exactly the .m Q40 footprint) is the main single-chip perf lever.
+
+Device layout, chosen so that unpacking needs no nibble interleave:
+
+    packed: uint8 [..., d_in//2, d_out]
+        packed[i, o] = (v[i, o] + 8) | ((v[i + d_in//2, o] + 8) << 4)
+    scales: float16 [..., d_in//32, d_out]
+        scales[b, o] covers input rows i in [32b, 32b+32)
+
+i.e. the weight is stored transposed ([d_in, d_out], ready for y = x @ W)
+with the low-nibble plane holding the first half of d_in and the high-nibble
+plane the second half — unpack is two shifts + a concat, both layout-friendly
+on TPU (the split planes are contiguous sublane ranges). Matmul reduction
+order is i-invariant, so any consistent permutation of d_in would be legal;
+the identity-halves choice keeps x untouched and scales in original block
+order. Dequantization is (nibble - 8) * f16(scale), bit-identical to
+src/nn/nn-quants.cpp:229-246.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from .codec import Q40_BLOCK_SIZE, q40_to_planar, quantize_q40
+
+
+class PackedQ40(NamedTuple):
+    """A Q40-quantized matmul weight resident on device.
+
+    Logical shape [..., d_in, d_out] for y = x @ W; ``logical_shape`` helpers
+    below recover it from the stored planes.
+    """
+
+    packed: jnp.ndarray  # uint8 [..., d_in//2, d_out]
+    scales: jnp.ndarray  # float16 [..., d_in//32, d_out]
+
+    @property
+    def d_in(self) -> int:
+        return self.packed.shape[-2] * 2
+
+    @property
+    def d_out(self) -> int:
+        return self.packed.shape[-1]
+
+
+def pack_q40_planar(values: np.ndarray, scales: np.ndarray):
+    """Host-side repack: planar int8 values [..., d_out, d_in] (centered at 0,
+    file orientation) + f16-exact scales [..., d_out, d_in//32] -> the device
+    layout (packed uint8 [..., d_in//2, d_out], scales f16 [..., d_in//32, d_out])."""
+    d_in = values.shape[-1]
+    assert d_in % Q40_BLOCK_SIZE == 0 and d_in % 2 == 0, values.shape
+    v = np.swapaxes(values, -1, -2)  # [..., d_in, d_out]
+    half = d_in // 2
+    lo = (v[..., :half, :].astype(np.int16) + 8).astype(np.uint8)
+    hi = (v[..., half:, :].astype(np.int16) + 8).astype(np.uint8)
+    packed = (lo & 0x0F) | ((hi & 0x0F) << 4)
+    scales_t = np.swapaxes(scales, -1, -2).astype(np.float16)  # [..., d_in//32, d_out]
+    return packed, scales_t
+
+
+def pack_q40_from_blocks(raw_blocks: np.ndarray, shape: tuple[int, int]):
+    """Packed .m Q40 block bytes (row-major over [d_out, d_in], blocks along
+    d_in — src/llm.cpp:447-483 tensor layout) -> device layout, WITHOUT
+    dequantizing. Returns (packed uint8 [d_in//2, d_out], scales f16
+    [d_in//32, d_out])."""
+    d_out, d_in = shape
+    values, scales = q40_to_planar(raw_blocks)  # [(d_out*d_in/32), 32], f32 scales
+    values = values.reshape(d_out, d_in)
+    scales = scales.reshape(d_out, d_in // Q40_BLOCK_SIZE)
+    return pack_q40_planar(values, scales)
+
+
+def pack_q40_host(w: np.ndarray):
+    """Quantize a float weight in file orientation [..., d_out, d_in] to the
+    device layout (through the bit-exact Q40 encoder, codec.quantize_q40)."""
+    lead = w.shape[:-2]
+    d_out, d_in = w.shape[-2], w.shape[-1]
+    blocks = quantize_q40(np.ascontiguousarray(w, np.float32).reshape(-1))
+    values, scales = q40_to_planar(blocks)
+    values = values.reshape(*lead, d_out, d_in)
+    scales = scales.reshape(*lead, d_out, d_in // Q40_BLOCK_SIZE)
+    return pack_q40_planar(values, scales)
+
+
+def unpack_q40(w: PackedQ40, dtype=jnp.float32) -> jnp.ndarray:
+    """Dequantize to a dense [..., d_in, d_out] array (XLA fallback path;
+    the Pallas kernel in ops/pallas_q40.py does this tile-wise in VMEM)."""
+    lo = (w.packed & 0x0F).astype(jnp.int8) - 8
+    hi = (w.packed >> 4).astype(jnp.int8) - 8
+    vals = jnp.concatenate([lo, hi], axis=-2)  # [..., d_in, d_out]
+    scales = jnp.repeat(
+        w.scales.astype(jnp.float32), Q40_BLOCK_SIZE, axis=-2
+    )  # [..., d_in, d_out]
+    return (vals.astype(jnp.float32) * scales).astype(dtype)
+
+
+def q40_matmul_xla(x: jnp.ndarray, w: PackedQ40, compute_dtype=None) -> jnp.ndarray:
+    """y = x @ dequant(w) without a Pallas kernel. XLA fuses the unpack/scale
+    into the matmul's weight-read loop where it can; correctness path for CPU
+    tests and the fallback when Pallas is unavailable."""
+    dtype = compute_dtype or x.dtype
+    wd = unpack_q40(w, dtype)
+    return jnp.matmul(x, wd, preferred_element_type=jnp.float32).astype(x.dtype)

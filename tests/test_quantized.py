@@ -1,0 +1,192 @@
+"""On-device packed Q40 weights: pack/unpack exactness, quantized matmul,
+full-model forward with quantized params, and quantized .m loading.
+
+The reference analogue is matmul_Q80_Q40_F32 vs matmul_F32 equivalence in
+src/nn/nn-cpu-ops-test.cpp:220-241 (tolerance there 4.0 on 4096-dim dots);
+here dequantization is exact by construction, so the checks are tighter.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_multiusers_tpu.quants.codec import (
+    dequantize_q40,
+    quantize_q40,
+)
+from distributed_llama_multiusers_tpu.quants.packed import (
+    PackedQ40,
+    pack_q40_from_blocks,
+    pack_q40_host,
+    q40_matmul_xla,
+    unpack_q40,
+)
+
+
+def test_pack_unpack_matches_reference_dequant():
+    rng = np.random.default_rng(0)
+    d_out, d_in = 48, 64
+    w = rng.standard_normal((d_out, d_in)).astype(np.float32)
+    blocks = quantize_q40(w.reshape(-1))
+    golden = dequantize_q40(blocks).reshape(d_out, d_in)  # reference dequant
+
+    pk, sc = pack_q40_from_blocks(blocks, (d_out, d_in))
+    assert pk.shape == (d_in // 2, d_out) and pk.dtype == np.uint8
+    assert sc.shape == (d_in // 32, d_out) and sc.dtype == np.float16
+
+    dev = unpack_q40(PackedQ40(jnp.asarray(pk), jnp.asarray(sc)), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(dev), golden.T)
+
+
+def test_pack_q40_host_equals_pack_from_blocks():
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((2, 16, 64)).astype(np.float32)  # [L, d_out, d_in]
+    pk, sc = pack_q40_host(w)
+    assert pk.shape == (2, 32, 16) and sc.shape == (2, 2, 16)
+    for layer in range(2):
+        blocks = quantize_q40(w[layer].reshape(-1))
+        pk1, sc1 = pack_q40_from_blocks(blocks, (16, 64))
+        np.testing.assert_array_equal(pk[layer], pk1)
+        np.testing.assert_array_equal(sc[layer], sc1)
+
+
+def test_q40_matmul_xla_matches_dense():
+    rng = np.random.default_rng(2)
+    d_in, d_out, b = 128, 96, 4
+    w = rng.standard_normal((d_out, d_in)).astype(np.float32)
+    x = rng.standard_normal((b, d_in)).astype(np.float32)
+    pk, sc = pack_q40_host(w)
+    pq = PackedQ40(jnp.asarray(pk), jnp.asarray(sc))
+
+    golden_w = dequantize_q40(quantize_q40(w.reshape(-1))).reshape(d_out, d_in)
+    want = x @ golden_w.T
+    got = np.asarray(q40_matmul_xla(jnp.asarray(x), pq))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_forward_quantized_close_to_dense():
+    from distributed_llama_multiusers_tpu.models import (
+        init_kv_cache,
+        llama_forward,
+        params_from_random,
+        quantize_params,
+    )
+    from distributed_llama_multiusers_tpu.models.config import LlamaConfig
+
+    config = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        vocab_size=96, seq_len=32,
+    )
+    params = params_from_random(config, seed=3, dtype=jnp.float32)
+    qparams = quantize_params(params)
+    assert isinstance(qparams.layers.wq, PackedQ40)
+    assert isinstance(qparams.wcls, PackedQ40)
+
+    tokens = jnp.asarray(np.random.default_rng(4).integers(0, 96, (2, 8)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32)[None], (2, 8))
+    logits_d, _ = llama_forward(config, params, tokens, positions, init_kv_cache(config, 2))
+    logits_q, _ = llama_forward(config, qparams, tokens, positions, init_kv_cache(config, 2))
+    # 4-bit weights: expect small but nonzero drift vs dense
+    diff = np.abs(np.asarray(logits_q) - np.asarray(logits_d))
+    assert np.isfinite(np.asarray(logits_q)).all()
+    assert diff.mean() < 0.5, diff.mean()
+
+
+def test_forward_quantized_exact_vs_host_dequantized_weights():
+    """Dequantizing on device inside the matmul must equal running the dense
+    forward on host-dequantized weights — dequant itself is lossless."""
+    from distributed_llama_multiusers_tpu.models import (
+        init_kv_cache,
+        llama_forward,
+        params_from_random,
+        quantize_params,
+    )
+    from distributed_llama_multiusers_tpu.models.config import LlamaConfig
+
+    config = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        vocab_size=96, seq_len=32,
+    )
+    params = params_from_random(config, seed=5, dtype=jnp.float32)
+    qparams = quantize_params(params)
+
+    def dq(w):
+        if isinstance(w, PackedQ40):
+            return unpack_q40(w, jnp.float32)
+        return w
+
+    dq_layers = qparams.layers._replace(
+        **{k: dq(getattr(qparams.layers, k)) for k in ("wq", "wk", "wv", "wo", "w1", "w2", "w3")}
+    )
+    dq_params = qparams._replace(layers=dq_layers, wcls=dq(qparams.wcls))
+
+    tokens = jnp.asarray(np.random.default_rng(6).integers(0, 96, (1, 4)), jnp.int32)
+    positions = jnp.arange(4, dtype=jnp.int32)[None]
+    logits_q, _ = llama_forward(config, qparams, tokens, positions, init_kv_cache(config, 1))
+    logits_dq, _ = llama_forward(config, dq_params, tokens, positions, init_kv_cache(config, 1))
+    np.testing.assert_allclose(np.asarray(logits_q), np.asarray(logits_dq), rtol=1e-6, atol=1e-6)
+
+
+def test_load_params_from_m_quantized(tiny_model):
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.models import (
+        init_kv_cache,
+        llama_forward,
+        load_params_from_m,
+        load_params_from_m_quantized,
+    )
+
+    header = tiny_model["header"]
+    path = tiny_model["model"]
+    header2 = load_model_header(path)
+    config, qparams = load_params_from_m_quantized(path, header2, dtype=jnp.float32)
+    _, dparams = load_params_from_m(path, header2, dtype=jnp.float32)
+    assert isinstance(qparams.layers.wq, PackedQ40)
+
+    tokens = jnp.asarray([[1, 2, 3]], jnp.int32)
+    positions = jnp.arange(3, dtype=jnp.int32)[None]
+    logits_q, _ = llama_forward(config, qparams, tokens, positions, init_kv_cache(config, 1))
+    logits_d, _ = llama_forward(config, dparams, tokens, positions, init_kv_cache(config, 1))
+    # both paths dequantize the same Q40 bytes -> identical f32 weights
+    np.testing.assert_allclose(
+        np.asarray(logits_q), np.asarray(logits_d), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_quantized_params_shard_and_forward_on_mesh():
+    """PackedQ40 params must flow through shard_params + a TP forward (the
+    reference runs Q40 weights sharded across nodes; here: across the mesh)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_llama_multiusers_tpu.models import (
+        init_kv_cache,
+        llama_forward,
+        params_from_random,
+        quantize_params,
+    )
+    from distributed_llama_multiusers_tpu.models.config import LlamaConfig
+    from distributed_llama_multiusers_tpu.parallel import MeshPlan, make_mesh
+    from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+
+    config = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        vocab_size=96, seq_len=32,
+    )
+    params = params_from_random(config, seed=7, dtype=jnp.float32)
+    qparams = quantize_params(params)
+    mesh = make_mesh(MeshPlan(dp=2, tp=2, sp=2))
+    sharded = shard_params(qparams, mesh)
+    assert isinstance(sharded.layers.wq, PackedQ40)
+
+    tokens = jnp.asarray(np.random.default_rng(8).integers(0, 96, (2, 4)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32)[None], (2, 4))
+    cache = init_kv_cache(config, 2)
+
+    logits_sharded, _ = jax.jit(
+        lambda p, t, pos, c: llama_forward(config, p, t, pos, c)
+    )(sharded, tokens, positions, cache)
+    logits_local, _ = llama_forward(config, qparams, tokens, positions, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits_sharded), np.asarray(logits_local), rtol=2e-5, atol=2e-5
+    )
