@@ -1,16 +1,22 @@
-"""Benchmark: batched decode throughput of the flagship model on real TPU.
+"""Benchmark: single-stream decode throughput of the flagship model on TPU.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Metric: single-stream (batch=1) decode tokens/sec for a Llama-3.2-1B-shaped
-bf16 model with a 2048-token KV cache, measured over 64 steps after warmup.
+Metric: batch=1 greedy decode tokens/sec for a Llama-3.2-1B-shaped model with
+Q40 weights at rest in HBM (int4+f16 scales, dequant-in-matmul Pallas kernel
+— the same weight format the reference runs, src/nn/nn-quants.hpp:64-67) and
+a 2048-token KV cache.
+
+Timing is honest under async dispatch: the whole generation loop runs
+device-side (lax.scan with the sampled token fed back), completion is forced
+by fetching the produced tokens, and the reported rate is the MARGINAL rate
+between a short and a long run — constant dispatch/transfer overheads cancel.
 
 vs_baseline: ratio against the reference's best published single-device
 number — Llama 2 7B on 1x RPi 4B at 1312.50 ms/token = 0.762 tok/s
 (report.pdf Fig. 3, BASELINE.md). Caveat: model sizes differ (1B here vs 7B
 there); the 7B/8-node figure (588 ms/token, 1.70 tok/s) is the distributed
-headline this framework targets at scale. Later rounds calibrate against the
-reference built from source on identical synthetic models.
+headline this framework targets at scale.
 """
 
 from __future__ import annotations
@@ -19,6 +25,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -28,6 +35,7 @@ REFERENCE_SINGLE_DEVICE_TOK_S = 1000.0 / 1312.50  # report.pdf Fig. 3
 def main() -> None:
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from __graft_entry__ import _flagship_config
     from distributed_llama_multiusers_tpu.models import (
@@ -35,40 +43,67 @@ def main() -> None:
         llama_forward,
         params_from_random,
     )
+    from distributed_llama_multiusers_tpu.models.loader import quantize_params
 
     small = os.environ.get("GRAFT_SMALL") == "1"
     config = _flagship_config(small=small)
-    params = params_from_random(config, seed=0, dtype=jnp.bfloat16)
-    cache = init_kv_cache(config, n_lanes=1, dtype=jnp.bfloat16)
+    # generate + quantize host-side; upload only the packed ~4.5-bit planes
+    host = quantize_params(
+        params_from_random(config, seed=0, dtype=jnp.bfloat16, to_device=False),
+        to_device=False,
+    )
+    params = jax.tree.map(jax.device_put, host)
 
-    from functools import partial
+    def make_generate(n_steps):
+        @partial(jax.jit, donate_argnums=(1,))
+        def generate(params, cache, first_token, start_pos):
+            def body(carry, _):
+                tok, pos, cache = carry
+                logits, cache = llama_forward(
+                    config, params, tok[:, None], pos[:, None], cache
+                )
+                nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+                return (nxt, pos + 1, cache), nxt
 
-    # donate the cache so XLA updates it in place instead of copying ~64 MB
-    # of KV per step
-    @partial(jax.jit, donate_argnums=(3,))
-    def decode_step(params, tokens, positions, cache):
-        return llama_forward(config, params, tokens, positions, cache)
+            (_, _, cache), toks = jax.lax.scan(
+                body,
+                (first_token, start_pos, cache),
+                None,
+                length=n_steps,
+            )
+            return toks, cache
 
-    tok = jnp.zeros((1, 1), jnp.int32)
+        return generate
 
-    # warmup / compile
-    logits, cache = decode_step(params, tok, jnp.array([[0]], jnp.int32), cache)
-    logits.block_until_ready()
+    first = jnp.zeros((1,), jnp.int32)
+    pos0 = jnp.zeros((1,), jnp.int32)
 
-    n_steps = 16 if small else 64
-    start_pos = 1
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        pos = jnp.array([[start_pos + i]], jnp.int32)
-        logits, cache = decode_step(params, tok, pos, cache)
-    logits.block_until_ready()
-    dt = time.perf_counter() - t0
+    def timed(n_steps, reps=3):
+        gen = make_generate(n_steps)
+        best = float("inf")
+        for _ in range(reps + 1):  # first rep is compile+warmup
+            cache = init_kv_cache(config, n_lanes=1, dtype=jnp.bfloat16)
+            t0 = time.perf_counter()
+            toks, cache = gen(params, cache, first, pos0)
+            np.asarray(toks)  # forces completion (block_until_ready may not)
+            dt = time.perf_counter() - t0
+            best = min(best, dt)
+        return best
 
-    tok_s = n_steps / dt
+    n_short, n_long = (4, 16) if small else (16, 128)
+    t_short = timed(n_short)
+    t_long = timed(n_long)
+    if t_long - t_short > 0.1 * t_long:
+        tok_s = (n_long - n_short) / (t_long - t_short)
+    else:
+        # marginal signal below dispatch-overhead noise (tiny models / fast
+        # chips): report the conservative whole-run rate instead
+        tok_s = n_long / t_long
+
     print(
         json.dumps(
             {
-                "metric": "llama32_1b_bf16_decode_tok_s",
+                "metric": "llama32_1b_q40_decode_tok_s",
                 "value": round(tok_s, 2),
                 "unit": "tok/s",
                 "vs_baseline": round(tok_s / REFERENCE_SINGLE_DEVICE_TOK_S, 2),

@@ -39,6 +39,11 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "activation casts (bit-fidelity mode); f32 (default) runs clean — "
                         "the reference defaults to q80 because its TCP links need the "
                         "bandwidth, which ICI does not")
+    p.add_argument("--weights", default="auto", choices=["auto", "packed", "dense"],
+                   help="Q40 models: 'packed' keeps int4+scales resident in HBM with "
+                        "dequant-in-matmul (the reference's Q40-at-rest execution, "
+                        "src/nn/nn-cpu-ops.cpp:222-440); 'dense' dequantizes at load. "
+                        "auto = packed on TPU, dense elsewhere")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--topp", type=float, default=0.9)
     p.add_argument("--seed", type=int, default=None)

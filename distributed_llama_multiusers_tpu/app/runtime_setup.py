@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 from ..formats import load_model_header
 from ..models import load_params_from_m
+from ..models.loader import load_params_from_m_quantized
 from ..parallel import make_mesh, validate_mesh_for_config
 from ..parallel.sharding import shard_params
 from ..runtime import ContinuousBatchingScheduler, InferenceEngine
@@ -38,13 +39,30 @@ def load_stack(args, n_lanes: int | None = None):
     tokenizer = Tokenizer(args.tokenizer)
     log("📄", f"Vocab: {tokenizer.vocab_size}  Bos: {tokenizer.bos_id}  Eos: {tokenizer.eos_token_ids}")
 
-    config, params = load_params_from_m(args.model, header, dtype=config_dtype)
+    weights_mode = getattr(args, "weights", "auto")
+    if weights_mode == "auto":
+        weights_mode = "packed" if jax.default_backend() == "tpu" else "dense"
+    if weights_mode == "packed":
+        config, params = load_params_from_m_quantized(args.model, header, dtype=config_dtype)
+        from ..quants.packed import PackedQ40
+
+        if any(isinstance(x, PackedQ40) for x in [params.wcls, params.layers.wq]):
+            log("🔷", "Q40 weights resident in HBM (dequant-in-matmul)")
+        else:
+            log("🔶", "model has no Q40 tensors; loaded dense")
+    else:
+        config, params = load_params_from_m(args.model, header, dtype=config_dtype)
 
     plan = parse_mesh_spec(args.workers)
     if plan is not None and plan.n_devices > 1:
         validate_mesh_for_config(config, plan)
         mesh = make_mesh(plan)
         params = shard_params(params, mesh)
+        from ..ops.linear import set_pallas_enabled
+
+        # GSPMD cannot partition the Pallas kernel; sharded forwards take the
+        # XLA dequant path (shard_map wrapping is the planned upgrade)
+        set_pallas_enabled(False)
         log("⭕", f"Mesh: dp={plan.dp} tp={plan.tp} sp={plan.sp} over {plan.n_devices} devices")
     log("💿", "Weights loaded")
 

@@ -9,9 +9,12 @@ placement/transfer that NnRootWeightLoader did by hand.
 
 from __future__ import annotations
 
+import ml_dtypes
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+_BF16_NP = np.dtype(ml_dtypes.bfloat16)
 
 from ..formats.model_file import ModelHeader, iter_model_tensors
 from ..ops.rope import build_rope_cache
@@ -79,6 +82,29 @@ def read_m_tensors(path: str, header: ModelHeader) -> dict:
     return w
 
 
+def _rope_cache(config: LlamaConfig):
+    return build_rope_cache(
+        config.seq_len,
+        config.head_size,
+        config.rope_theta,
+        config.rope_scaling_factor,
+        config.rope_scaling_low_freq_factor,
+        config.rope_scaling_high_freq_factor,
+        config.rope_scaling_orig_max_seq_len,
+    )
+
+
+def _cast_fn(dtype):
+    """Host-side pre-cast where a numpy dtype exists; bf16 has no plain numpy
+    dtype, so it casts at device_put time instead."""
+    np_dtype = np.dtype(jnp.dtype(dtype).name) if jnp.dtype(dtype) != jnp.bfloat16 else None
+
+    def cast(x: np.ndarray) -> np.ndarray:
+        return x if np_dtype is None else x.astype(np_dtype)
+
+    return cast
+
+
 def load_params_from_m(
     path: str,
     header: ModelHeader,
@@ -107,20 +133,8 @@ def load_params_from_m(
         else:
             stacked[key] = np.stack([m.T for m in mats])  # -> [L, d_in, d_out]
 
-    np_dtype = np.dtype(jnp.dtype(dtype).name) if jnp.dtype(dtype) != jnp.bfloat16 else None
-
-    def cast(x: np.ndarray) -> np.ndarray:
-        # bf16 has no numpy dtype; jnp.asarray handles the cast at put time
-        return x if np_dtype is None else x.astype(np_dtype)
-    cos, sin = build_rope_cache(
-        config.seq_len,
-        config.head_size,
-        config.rope_theta,
-        config.rope_scaling_factor,
-        config.rope_scaling_low_freq_factor,
-        config.rope_scaling_high_freq_factor,
-        config.rope_scaling_orig_max_seq_len,
-    )
+    cast = _cast_fn(dtype)
+    cos, sin = _rope_cache(config)
 
     layers = LlamaLayerParams(
         wq=put("wq", cast(stacked["wq"])).astype(dtype),
@@ -186,12 +200,7 @@ def load_params_from_m_quantized(
                 dense.setdefault(key, [None] * L)
                 dense[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
 
-    # host-cast before device_put where a numpy dtype exists (bf16 casts at
-    # put time) — same contract as load_params_from_m's cast()
-    np_dtype = np.dtype(jnp.dtype(dtype).name) if jnp.dtype(dtype) != jnp.bfloat16 else None
-
-    def cast(x: np.ndarray) -> np.ndarray:
-        return x if np_dtype is None else x.astype(np_dtype)
+    cast = _cast_fn(dtype)
 
     def stack_packed(key: str):
         mats = packed_w[key]
@@ -200,18 +209,17 @@ def load_params_from_m_quantized(
                 packed=put(key, np.stack([m[0] for m in mats])),
                 scales=put(key + ".scales", np.stack([m[1] for m in mats])),
             )
+        if any(m is not None for m in mats):
+            # float_type is per-tensor in the .m header, so this is encodable
+            # but no converter emits it; fail clearly rather than stack holes
+            raise ValueError(
+                f"{key}: layers mix Q40 and non-Q40 float types; "
+                "per-layer mixed quantization is not supported"
+            )
         # dense fallback (non-Q40 model): same path as load_params_from_m
         return put(key, cast(np.stack([m.T for m in dense[key]]))).astype(dtype)
 
-    cos, sin = build_rope_cache(
-        config.seq_len,
-        config.head_size,
-        config.rope_theta,
-        config.rope_scaling_factor,
-        config.rope_scaling_low_freq_factor,
-        config.rope_scaling_high_freq_factor,
-        config.rope_scaling_orig_max_seq_len,
-    )
+    cos, sin = _rope_cache(config)
     layers = LlamaLayerParams(
         **{k: stack_packed(k) for k in _MATMUL_KEYS},
         rms_att=put("rms_att", np.stack(dense["rms_att"])).astype(jnp.float32),
@@ -233,24 +241,36 @@ def load_params_from_m_quantized(
     return config, params
 
 
-def quantize_params(params: LlamaParams) -> LlamaParams:
+def quantize_params(params: LlamaParams, to_device: bool = True) -> LlamaParams:
     """Quantize a dense params pytree to PackedQ40 layer matmuls + wcls
-    (through the bit-exact Q40 encoder). Host-side; used by benchmarks and
-    tests so multi-GB Q40 files need not exist on disk."""
+    (through the bit-exact Q40 encoder). Fully host-side for numpy inputs —
+    combine with ``params_from_random(..., to_device=False)`` so multi-GB
+    dense weights never cross the host<->device link (which can be a slow
+    tunnel); with ``to_device=False`` the packed planes also stay numpy for
+    the caller to place (e.g. with mesh shardings)."""
+    up = jnp.asarray if to_device else (lambda x: x)
 
     def q(w) -> PackedQ40:
         # w: [L?, d_in, d_out] device/numpy array -> file orientation then pack
-        wf = np.asarray(jnp.swapaxes(jnp.asarray(w, jnp.float32), -1, -2))
+        wf = np.swapaxes(np.asarray(w, np.float32), -1, -2)
         pk, sc = pack_q40_host(wf)
-        return PackedQ40(packed=jnp.asarray(pk), scales=jnp.asarray(sc))
+        return PackedQ40(packed=up(pk), scales=up(sc))
 
     layers = params.layers._replace(**{k: q(getattr(params.layers, k)) for k in _MATMUL_KEYS})
     return params._replace(layers=layers, wcls=q(params.wcls))
 
 
-def params_from_random(config: LlamaConfig, seed: int = 0, dtype=jnp.bfloat16, scale: float = 0.02) -> LlamaParams:
+def params_from_random(
+    config: LlamaConfig,
+    seed: int = 0,
+    dtype=jnp.bfloat16,
+    scale: float = 0.02,
+    to_device: bool = True,
+) -> LlamaParams:
     """Random-weight params with the right shapes — used by benchmarks so that
-    multi-GB models need not exist on disk."""
+    multi-GB models need not exist on disk. ``to_device=False`` keeps every
+    leaf a host numpy array (bf16 via ml_dtypes) so nothing crosses the
+    host->device link until the caller places it."""
     rng = np.random.default_rng(seed)
     L, dim, hidden, kv_dim, vocab = (
         config.n_layers,
@@ -260,18 +280,18 @@ def params_from_random(config: LlamaConfig, seed: int = 0, dtype=jnp.bfloat16, s
         config.vocab_size,
     )
 
-    def r(*shape):
-        return jnp.asarray(rng.standard_normal(shape, dtype=np.float32) * scale, dtype=dtype)
-
-    cos, sin = build_rope_cache(
-        config.seq_len,
-        config.head_size,
-        config.rope_theta,
-        config.rope_scaling_factor,
-        config.rope_scaling_low_freq_factor,
-        config.rope_scaling_high_freq_factor,
-        config.rope_scaling_orig_max_seq_len,
+    np_dtype = (
+        _BF16_NP if jnp.dtype(dtype) == jnp.bfloat16 else np.dtype(jnp.dtype(dtype).name)
     )
+
+    def arr(x, d=None):
+        return jnp.asarray(x, dtype=d) if to_device else np.asarray(x, dtype=d)
+
+    def r(*shape):
+        w = rng.standard_normal(shape, dtype=np.float32) * scale
+        return jnp.asarray(w, dtype=dtype) if to_device else w.astype(np_dtype)
+
+    cos, sin = _rope_cache(config)
     layers = LlamaLayerParams(
         wq=r(L, dim, dim),
         wk=r(L, dim, kv_dim),
@@ -280,14 +300,14 @@ def params_from_random(config: LlamaConfig, seed: int = 0, dtype=jnp.bfloat16, s
         w1=r(L, dim, hidden),
         w2=r(L, hidden, dim),
         w3=r(L, dim, hidden),
-        rms_att=jnp.ones((L, dim), jnp.float32),
-        rms_ffn=jnp.ones((L, dim), jnp.float32),
+        rms_att=arr(np.ones((L, dim), np.float32)),
+        rms_ffn=arr(np.ones((L, dim), np.float32)),
     )
     return LlamaParams(
         embedding=r(vocab, dim),
         layers=layers,
-        rms_final=jnp.ones((dim,), jnp.float32),
+        rms_final=arr(np.ones((dim,), np.float32)),
         wcls=r(dim, vocab),
-        rope_cos=jnp.asarray(cos),
-        rope_sin=jnp.asarray(sin),
+        rope_cos=arr(cos),
+        rope_sin=arr(sin),
     )

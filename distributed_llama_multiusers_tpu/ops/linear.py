@@ -17,6 +17,17 @@ import jax.numpy as jnp
 
 from ..quants.packed import PackedQ40, q40_matmul_xla
 
+# Pallas has no GSPMD partitioning rule: on a multi-chip mesh the sharded
+# forward must take the XLA dequant path (which partitions cleanly) until the
+# kernel is wrapped in shard_map. runtime_setup flips this off when it builds
+# a >1-device mesh.
+_pallas_enabled = True
+
+
+def set_pallas_enabled(enabled: bool) -> None:
+    global _pallas_enabled
+    _pallas_enabled = enabled
+
 
 @lru_cache(maxsize=1)
 def _pallas_q40_matmul():
@@ -42,8 +53,11 @@ def _pallas_q40_matmul():
 def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """y = x @ w for dense [.., d_in, d_out] arrays or PackedQ40 weights."""
     if isinstance(w, PackedQ40):
-        kernel = _pallas_q40_matmul()
+        kernel = _pallas_q40_matmul() if _pallas_enabled else None
         if kernel is not None:
-            return kernel(x, w)
+            from .pallas_q40 import pallas_supports
+
+            if pallas_supports(w):
+                return kernel(x, w)
         return q40_matmul_xla(x, w)
     return x @ w

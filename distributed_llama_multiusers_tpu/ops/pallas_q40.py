@@ -1,0 +1,180 @@
+"""Pallas TPU kernel: y = x @ dequant(W) for Q40-packed weights.
+
+The TPU analogue of the reference's dequant-in-matmul kernels
+(matmul_Q80_Q40_F32, src/nn/nn-cpu-ops.cpp:222-440, and the Vulkan shader
+src/nn/vulkan/matmul-forward-q80-q40-f32.comp): weights stay int4+f16-scale
+in HBM (~4.5 bits/element) and are expanded to f32 tile-by-tile in VMEM,
+never materializing the dense weight in HBM. Decode-time matmuls are
+HBM-bandwidth-bound, so reading 4.5 bits instead of 16 (bf16) per element is
+the main single-chip throughput lever.
+
+Layout (quants/packed.py): block-local nibble halves — each 32-input quant
+block is 16 consecutive packed rows (low nibble = block inputs [0,16), high
+nibble = [16,32)) + 1 scale row, so a chunk of whole blocks covers the same
+contiguous input range in `packed`, `scales`, and `x`.
+
+Grid: (m tiles, d_out tiles, d_in chunks). The d_in axis is the reduction
+(innermost, "arbitrary"); the output tile accumulates across it in an f32
+VMEM scratch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..quants.packed import PackedQ40
+
+# Upper bounds; actual tiles are fitted to the operand (see _pick_*).
+DIN_CHUNK = 2048  # input rows per reduction step
+DOUT_TILE = 512
+M_TILE = 256
+ROW_ALIGN = 8  # x rows padded to this multiple
+
+
+def _f16_bits_to_f32(h: jnp.ndarray) -> jnp.ndarray:
+    """Exact f16 -> f32 from int16 bit patterns (Mosaic has no f16 type).
+
+    Exact for all finite f16 values, which the Q40 encoder guarantees.
+    Normals: rebias the exponent into f32 position. Denormals: mant * 2^-24
+    as a float product — no denormal f32 intermediates, so flush-to-zero
+    hardware (XLA:CPU, TPU) cannot corrupt them."""
+    h32 = h.astype(jnp.int32) & 0xFFFF
+    exp = (h32 >> 10) & 0x1F
+    mant = h32 & 0x3FF
+    normal = jax.lax.bitcast_convert_type(
+        ((exp + 112) << 23) | (mant << 13), jnp.float32
+    )
+    denorm = mant.astype(jnp.float32) * jnp.float32(5.9604644775390625e-08)  # 2^-24
+    mag = jnp.where(exp == 0, denorm, normal)
+    return jnp.where(h32 >> 15 != 0, -mag, mag)
+
+
+def _q40_matmul_kernel(x_ref, packed_ref, scales_ref, out_ref, acc_ref):
+    """One (m tile, d_out tile, d_in chunk) step.
+
+    x: [mt, chunk] f32 (chunk = contiguous input columns). packed:
+    [chunk//2, tile] uint8 (block-local nibble halves). scales:
+    [chunk//32, tile] int16 (f16 bits). acc: [mt, tile] f32 scratch,
+    accumulated over the reduction grid axis.
+    """
+    k = pl.program_id(2)
+
+    p = packed_ref[...].astype(jnp.int32)  # int32: Mosaic lacks i8 arithmetic
+    half_rows, tile = packed_ref.shape
+    n_blk = half_rows // 16
+    pb = p.reshape(n_blk, 16, tile)
+    lo = (pb & 0x0F) - 8
+    hi = ((pb >> 4) & 0x0F) - 8
+    vals = jnp.concatenate([lo, hi], axis=1).astype(jnp.float32)  # [n_blk, 32, tile]
+    w = (vals * _f16_bits_to_f32(scales_ref[...])[:, None, :]).reshape(
+        n_blk * 32, tile
+    )
+
+    partial_sum = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[...] = partial_sum
+
+    @pl.when(k > 0)
+    def _():
+        acc_ref[...] = acc_ref[...] + partial_sum
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _pick_chunk(d_in: int) -> int | None:
+    """Largest divisor of d_in that is a multiple of 32 and <= DIN_CHUNK
+    (chunks must cover whole quant blocks). None unless d_in is 32-aligned;
+    32 itself always qualifies, so a 32-aligned d_in always gets a chunk."""
+    if d_in % 32 != 0:
+        return None
+    best = 32
+    for c in range(64, min(d_in, DIN_CHUNK) + 1, 32):
+        if d_in % c == 0:
+            best = c
+    return best
+
+
+def _pick_tile(n: int, cap: int) -> int:
+    for c in range(cap, 127, -128):
+        if n % c == 0:
+            return c
+    return n
+
+
+# the dequantized f32 weight tile (chunk x tile) must fit VMEM comfortably
+# alongside x, packed, scales, and the accumulator
+MAX_W_TILE_BYTES = 8 * 1024 * 1024
+
+
+def pallas_supports(w: PackedQ40) -> bool:
+    """True when the kernel's fitted block shapes are VMEM-safe; otherwise
+    callers should take the q40_matmul_xla fallback (ops/linear.py)."""
+    if w.packed.ndim != 2:
+        return False
+    chunk = _pick_chunk(w.d_in)
+    if chunk is None:
+        return False
+    tile = _pick_tile(w.d_out, DOUT_TILE)
+    return chunk * tile * 4 <= MAX_W_TILE_BYTES
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False) -> jnp.ndarray:
+    """y = x @ dequant(w). x: [..., d_in]; returns [..., d_out] in x.dtype."""
+    if w.packed.ndim != 2:
+        raise ValueError(f"expected 2D packed weight, got {w.packed.shape}")
+    d_in, d_out = w.d_in, w.d_out
+    chunk = _pick_chunk(d_in)
+    if chunk is None:
+        raise ValueError(f"d_in={d_in} not 32-divisible; use q40_matmul_xla")
+    lead = x.shape[:-1]
+    m = 1
+    for s in lead:
+        m *= s
+
+    xf = x.reshape(m, d_in).astype(jnp.float32)
+    m_pad = max(ROW_ALIGN, ((m + ROW_ALIGN - 1) // ROW_ALIGN) * ROW_ALIGN)
+    m_tile = min(M_TILE, m_pad)
+    if m_pad % m_tile != 0:
+        m_pad = ((m_pad + m_tile - 1) // m_tile) * m_tile
+    if m_pad != m:
+        xf = jnp.pad(xf, ((0, m_pad - m), (0, 0)))
+
+    tile = _pick_tile(d_out, DOUT_TILE)
+    grid = (m_pad // m_tile, d_out // tile, d_in // chunk)
+
+    scale_bits = jax.lax.bitcast_convert_type(w.scales, jnp.int16)
+
+    out = pl.pallas_call(
+        _q40_matmul_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m_tile, chunk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((chunk // 2, tile), lambda i, j, k: (k, j)),
+            pl.BlockSpec((chunk // 32, tile), lambda i, j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((m_tile, tile), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, d_out), x.dtype),
+        scratch_shapes=[pltpu.VMEM((m_tile, tile), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_pad * d_in * d_out,
+            bytes_accessed=d_in * d_out // 2 + (d_in // 32) * d_out * 2
+            + m_pad * d_in * 4 + m_pad * d_out * x.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(xf, w.packed, scale_bits)
+
+    return out[:m].reshape(*lead, d_out)

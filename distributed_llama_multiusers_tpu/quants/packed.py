@@ -7,21 +7,25 @@ dequantizes on the host and ships 4x the bytes to HBM. Since TPU decode is
 HBM-bandwidth-bound, keeping weights at 4 bit + 1/32 f16 scale (~4.5 bits/
 element, exactly the .m Q40 footprint) is the main single-chip perf lever.
 
-Device layout, chosen so that unpacking needs no nibble interleave:
+Device layout — block-local nibble halves, mirroring the .m Q40 block itself
+(scale, 16 low-half bytes = inputs [0,16), high nibbles = inputs [16,32);
+src/nn/nn-quants.hpp:64-67):
 
     packed: uint8 [..., d_in//2, d_out]
-        packed[i, o] = (v[i, o] + 8) | ((v[i + d_in//2, o] + 8) << 4)
+        row r = (b, j) with b = r // 16, j = r % 16:
+        packed[r, o] = (v[32b + j, o] + 8) | ((v[32b + j + 16, o] + 8) << 4)
     scales: float16 [..., d_in//32, d_out]
         scales[b, o] covers input rows i in [32b, 32b+32)
 
 i.e. the weight is stored transposed ([d_in, d_out], ready for y = x @ W)
-with the low-nibble plane holding the first half of d_in and the high-nibble
-plane the second half — unpack is two shifts + a concat, both layout-friendly
-on TPU (the split planes are contiguous sublane ranges). Matmul reduction
-order is i-invariant, so any consistent permutation of d_in would be legal;
-the identity-halves choice keeps x untouched and scales in original block
-order. Dequantization is (nibble - 8) * f16(scale), bit-identical to
-src/nn/nn-quants.cpp:229-246.
+and each 32-input quant block occupies 16 consecutive packed rows + 1 scale
+row. Both planes are therefore CONTIGUOUS and PROPORTIONAL in the input
+dimension: any slice of whole blocks — a TP shard of axis -2, or a Pallas
+reduction chunk — covers the same input range in `packed`, `scales`, and
+`x`, so identical PartitionSpecs shard both planes correctly (see
+parallel/sharding.py) and kernels need no cross-chunk gather. Unpack is two
+shifts + a block-local concat. Dequantization is (nibble - 8) * f16(scale),
+bit-identical to src/nn/nn-quants.cpp:229-246.
 """
 
 from __future__ import annotations
@@ -58,12 +62,16 @@ def pack_q40_planar(values: np.ndarray, scales: np.ndarray):
     file orientation) + f16-exact scales [..., d_out, d_in//32] -> the device
     layout (packed uint8 [..., d_in//2, d_out], scales f16 [..., d_in//32, d_out])."""
     d_in = values.shape[-1]
-    assert d_in % Q40_BLOCK_SIZE == 0 and d_in % 2 == 0, values.shape
+    assert d_in % Q40_BLOCK_SIZE == 0, values.shape
+    lead = values.shape[:-2]
+    d_out = values.shape[-2]
+    n_blk = d_in // Q40_BLOCK_SIZE
+    half = Q40_BLOCK_SIZE // 2
     v = np.swapaxes(values, -1, -2)  # [..., d_in, d_out]
-    half = d_in // 2
-    lo = (v[..., :half, :].astype(np.int16) + 8).astype(np.uint8)
-    hi = (v[..., half:, :].astype(np.int16) + 8).astype(np.uint8)
-    packed = (lo & 0x0F) | ((hi & 0x0F) << 4)
+    vb = v.reshape(*lead, n_blk, Q40_BLOCK_SIZE, d_out)
+    lo = (vb[..., :half, :].astype(np.int16) + 8).astype(np.uint8)
+    hi = (vb[..., half:, :].astype(np.int16) + 8).astype(np.uint8)
+    packed = ((lo & 0x0F) | ((hi & 0x0F) << 4)).reshape(*lead, d_in // 2, d_out)
     scales_t = np.swapaxes(scales, -1, -2).astype(np.float16)  # [..., d_in//32, d_out]
     return packed, scales_t
 
@@ -95,13 +103,17 @@ def pack_q40_host(w: np.ndarray):
 def unpack_q40(w: PackedQ40, dtype=jnp.float32) -> jnp.ndarray:
     """Dequantize to a dense [..., d_in, d_out] array (XLA fallback path;
     the Pallas kernel in ops/pallas_q40.py does this tile-wise in VMEM)."""
-    lo = (w.packed & 0x0F).astype(jnp.int8) - 8
-    hi = (w.packed >> 4).astype(jnp.int8) - 8
-    vals = jnp.concatenate([lo, hi], axis=-2)  # [..., d_in, d_out]
-    scales = jnp.repeat(
-        w.scales.astype(jnp.float32), Q40_BLOCK_SIZE, axis=-2
-    )  # [..., d_in, d_out]
-    return (vals.astype(jnp.float32) * scales).astype(dtype)
+    lead = w.packed.shape[:-2]
+    d_in, d_out = w.d_in, w.d_out
+    n_blk = d_in // Q40_BLOCK_SIZE
+    half = Q40_BLOCK_SIZE // 2
+    pb = w.packed.reshape(*lead, n_blk, half, d_out)
+    lo = (pb & 0x0F).astype(jnp.int8) - 8
+    hi = (pb >> 4).astype(jnp.int8) - 8
+    vals = jnp.concatenate([lo, hi], axis=-2)  # [..., n_blk, 32, d_out]
+    scales = w.scales.astype(jnp.float32)[..., :, None, :]
+    out = vals.astype(jnp.float32) * scales
+    return out.reshape(*lead, d_in, d_out).astype(dtype)
 
 
 def q40_matmul_xla(x: jnp.ndarray, w: PackedQ40, compute_dtype=None) -> jnp.ndarray:

@@ -40,5 +40,14 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
         for name in list(xla_bridge._backend_factories):
             if name != "cpu":
                 del xla_bridge._backend_factories[name]
+                # keep the NAME known: modules imported later (e.g. pallas ->
+                # checkify) register platform-specific lowerings and assert
+                # is_known_platform; only the factory must go, not the name
+                plugins = getattr(xla_bridge, "_nonexperimental_plugins", None)
+                if plugins is not None:
+                    plugins.add(name)
+        plugins = getattr(xla_bridge, "_nonexperimental_plugins", None)
+        if plugins is not None:
+            plugins.add("tpu")
     except ImportError:
         pass

@@ -1,0 +1,78 @@
+"""Pallas Q40 matmul kernel vs the XLA fallback (interpret mode on CPU).
+
+The reference's kernel-equivalence analogue is matmul_Q80_Q40_F32 vs
+matmul_F32 (src/nn/nn-cpu-ops-test.cpp:220-241); here the Pallas kernel and
+q40_matmul_xla dequantize identically, so results must agree to float
+rounding, not a quantization tolerance.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_multiusers_tpu.ops.pallas_q40 import (
+    _f16_bits_to_f32,
+    q40_matmul_pallas,
+)
+from distributed_llama_multiusers_tpu.quants.packed import (
+    PackedQ40,
+    pack_q40_host,
+    q40_matmul_xla,
+)
+
+
+def _pack(rng, d_out, d_in, scale=0.1):
+    w = rng.standard_normal((d_out, d_in), dtype=np.float32) * scale
+    packed, scales = pack_q40_host(w)
+    return PackedQ40(packed=jnp.asarray(packed), scales=jnp.asarray(scales))
+
+
+def test_f16_bit_conversion_exact():
+    # every finite f16 bit pattern converts exactly (incl. denormals)
+    bits = np.arange(65536, dtype=np.uint16)
+    h = bits.view(np.float16)
+    finite = np.isfinite(h)
+    got = np.asarray(_f16_bits_to_f32(jnp.asarray(bits.astype(np.int16))))
+    np.testing.assert_array_equal(got[finite], h[finite].astype(np.float32))
+
+
+@pytest.mark.parametrize(
+    "m,d_in,d_out",
+    [
+        (1, 64, 128),
+        (5, 256, 384),
+        (8, 2048, 512),
+        (16, 128, 256),
+        # d_in with no power-of-two chunk divisor (1376 = 43*32): the analogue
+        # of Llama-2-7B's hidden_dim 11008 that crashed the halves layout
+        (3, 1376, 128),
+    ],
+)
+def test_pallas_matches_xla(m, d_in, d_out):
+    rng = np.random.default_rng(d_in + d_out)
+    pw = _pack(rng, d_out, d_in)
+    x = jnp.asarray(rng.standard_normal((m, d_in), dtype=np.float32))
+    ref = q40_matmul_xla(x, pw)
+    got = q40_matmul_pallas(x, pw, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+def test_pallas_leading_batch_dims():
+    rng = np.random.default_rng(0)
+    pw = _pack(rng, 256, 128)
+    x = jnp.asarray(rng.standard_normal((2, 3, 128), dtype=np.float32))
+    ref = q40_matmul_xla(x, pw)
+    got = q40_matmul_pallas(x, pw, interpret=True)
+    assert got.shape == (2, 3, 256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+def test_pallas_extreme_scales():
+    # very small weights -> denormal f16 scales still convert exactly
+    rng = np.random.default_rng(1)
+    pw = _pack(rng, 128, 64, scale=1e-7)
+    x = jnp.asarray(rng.standard_normal((4, 64), dtype=np.float32))
+    ref = q40_matmul_xla(x, pw)
+    got = q40_matmul_pallas(x, pw, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-10)
