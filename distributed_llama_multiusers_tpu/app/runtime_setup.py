@@ -53,6 +53,7 @@ def load_stack(args, n_lanes: int | None = None):
     else:
         config, params = load_params_from_m(args.model, header, dtype=config_dtype)
 
+    mesh = None
     plan = parse_mesh_spec(args.workers)
     if plan is not None and plan.n_devices > 1:
         validate_mesh_for_config(config, plan)
@@ -77,6 +78,7 @@ def load_stack(args, n_lanes: int | None = None):
         n_lanes=n_lanes or args.max_lanes,
         cache_dtype=jnp.float32,
         emulate_q80_activations=emulate_q80,
+        mesh=mesh,
     )
     return config, params, tokenizer, engine
 

@@ -81,6 +81,28 @@ def _qdq_q80(x: jnp.ndarray) -> jnp.ndarray:
     return qdq_q80(x, mode="runtime")
 
 
+def _use_sp(mesh, b: int, t: int | None = None) -> bool:
+    """Whether attention should take the sequence-parallel shard_map path:
+    needs an sp>1 mesh and whole shards — lanes tiling dp (single-lane
+    prefill with dp>1 stays on GSPMD) and, when queries are sequence-sharded
+    (t given, ring attention), t tiling sp."""
+    if mesh is None or mesh.shape.get("sp", 1) <= 1:
+        return False
+    if b % mesh.shape.get("dp", 1) != 0:
+        return False
+    return t is None or t % mesh.shape["sp"] == 0
+
+
+def _dense_attention(qf, kf, vf, mask, scale):
+    """Single-device GQA attention with materialized scores (reference
+    multiheadAtt_F32, src/nn/nn-cpu-ops.cpp:749-784). qf: [B,T,K,G,H] f32;
+    kf/vf: [B,S,K,H] f32; mask: [B,T,S]."""
+    scores = jnp.einsum("btkgh,bskh->btkgs", qf * scale, kf)
+    scores = jnp.where(mask[:, :, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("btkgs,bskh->btkgh", probs, vf)
+
+
 def llama_forward(
     config: LlamaConfig,
     params: LlamaParams,
@@ -88,11 +110,17 @@ def llama_forward(
     positions: jnp.ndarray,  # [B, T] int32 (per-lane positions; fixes reference defect (b))
     cache: KVCache,
     emulate_q80_activations: bool = False,
+    mesh=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Returns (logits [B, T, vocab] float32, updated cache).
 
     Works for prefill (T > 1) and decode (T = 1) alike; the KV cache is
     per-lane (fixes reference defect (c) where all lanes shared one cache).
+
+    With ``mesh`` (axes dp/tp/sp) and sp > 1, attention runs sequence-
+    parallel over the S-sharded cache via flash-stats psum
+    (parallel/ring_attention.sp_attention) instead of relying on GSPMD to
+    partition the dense-scores einsum.
     """
     b, t = tokens.shape
     h_cfg = config
@@ -101,6 +129,7 @@ def llama_forward(
     act_fn = silu if h_cfg.hidden_act == HiddenAct.SILU else gelu
 
     maybe_qdq = _qdq_q80 if emulate_q80_activations else (lambda y: y)
+    use_sp = _use_sp(mesh, b)
 
     x = params.embedding[tokens]  # [B, T, dim]
     lane_idx = jnp.arange(b)[:, None]  # [B, 1]
@@ -129,12 +158,16 @@ def llama_forward(
         # GQA attention in f32 (reference multiheadAtt_F32, nn-cpu-ops.cpp:749-784)
         group = n_heads // n_kv
         qf = q.astype(jnp.float32).reshape(b, t, n_kv, group, hd)
-        kf = k_cache.astype(jnp.float32)  # [B, S, n_kv, hd]
-        vf = v_cache.astype(jnp.float32)
-        scores = jnp.einsum("btkgh,bskh->btkgs", qf, kf) / jnp.sqrt(jnp.float32(hd))
-        scores = jnp.where(attn_mask[:, :, None, None, :], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("btkgs,bskh->btkgh", probs, vf)
+        scale = 1.0 / float(hd) ** 0.5
+        if use_sp:
+            from ..parallel.ring_attention import sp_attention
+
+            attn = sp_attention(qf, k_cache, v_cache, positions, mesh, scale)
+        else:
+            attn = _dense_attention(
+                qf, k_cache.astype(jnp.float32), v_cache.astype(jnp.float32),
+                attn_mask, scale,
+            )
         attn = attn.reshape(b, t, n_heads * hd).astype(dtype)
 
         out = matmul(maybe_qdq(attn), lp.wo)
@@ -160,16 +193,23 @@ def llama_forward_train(
     config: LlamaConfig,
     params: LlamaParams,
     tokens: jnp.ndarray,  # [B, T] int32
+    mesh=None,
 ) -> jnp.ndarray:
     """Cache-free causal forward over a full sequence — the training-mode twin
     of ``llama_forward`` (the reference is inference-only; training support is
-    a capability extension, same layer math). Returns logits [B, T, vocab]."""
+    a capability extension, same layer math). Returns logits [B, T, vocab].
+
+    With ``mesh`` and sp > 1 the sequence axis is sharded and attention runs
+    as ring attention (KV blocks rotate over the sp axis via ppermute,
+    parallel/ring_attention.ring_attention) — long-context training/prefill
+    never materializes the full [T, T] score matrix per device."""
     b, t = tokens.shape
     n_heads, n_kv, hd = config.n_heads, config.n_kv_heads, config.head_size
     eps = config.norm_epsilon
     act_fn = silu if config.hidden_act == HiddenAct.SILU else gelu
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
-    causal = jnp.tril(jnp.ones((t, t), bool))
+    use_sp = _use_sp(mesh, b, t)
+    causal = None if use_sp else jnp.tril(jnp.ones((t, t), bool))
 
     x = params.embedding[tokens]
 
@@ -184,12 +224,17 @@ def llama_forward_train(
 
         group = n_heads // n_kv
         qf = q.astype(jnp.float32).reshape(b, t, n_kv, group, hd)
-        kf = k.astype(jnp.float32)
-        vf = v.astype(jnp.float32)
-        scores = jnp.einsum("btkgh,bskh->btkgs", qf, kf) / jnp.sqrt(jnp.float32(hd))
-        scores = jnp.where(causal[:, None, None, :], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("btkgs,bskh->btkgh", probs, vf).reshape(b, t, n_heads * hd)
+        scale = 1.0 / float(hd) ** 0.5
+        if use_sp:
+            from ..parallel.ring_attention import ring_attention
+
+            attn = ring_attention(qf, k.astype(jnp.float32), v.astype(jnp.float32), mesh, scale)
+        else:
+            attn = _dense_attention(
+                qf, k.astype(jnp.float32), v.astype(jnp.float32),
+                jnp.broadcast_to(causal[None], (b, t, t)), scale,
+            )
+        attn = attn.reshape(b, t, n_heads * hd)
         x = x + matmul(attn.astype(dtype), lp.wo)
 
         y = rms_norm(x, lp.rms_ffn, eps)

@@ -61,10 +61,12 @@ class InferenceEngine:
         prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
         cache_dtype=jnp.float32,
         emulate_q80_activations: bool = False,
+        mesh=None,
     ):
         self.config = config
         self.params = params
         self.n_lanes = n_lanes
+        self.mesh = mesh
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= config.seq_len
         ) or (min(16, config.seq_len),)
@@ -74,12 +76,14 @@ class InferenceEngine:
         cfg = config
         q80 = emulate_q80_activations
 
+        sp_mesh = mesh
+
         @partial(jax.jit, donate_argnums=(1,))
         def _decode(params, cache, tokens, positions):
             # tokens/positions: [n_lanes] -> [n_lanes, 1]
             logits, cache = llama_forward(
                 cfg, params, tokens[:, None], positions[:, None], cache,
-                emulate_q80_activations=q80,
+                emulate_q80_activations=q80, mesh=sp_mesh,
             )
             return logits[:, 0, :], jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32), cache
 
@@ -102,6 +106,7 @@ class InferenceEngine:
                 positions[None, :],
                 KVCache(k=k_lane, v=v_lane),
                 emulate_q80_activations=q80,
+                mesh=sp_mesh,
             )
             k = jax.lax.dynamic_update_slice_in_dim(cache.k, lane_cache.k, lane, axis=1)
             v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)

@@ -1,0 +1,178 @@
+"""Sequence-parallel attention vs the dense reference math (8-dev CPU mesh).
+
+The reference has no sequence parallelism (SURVEY.md §5.7); the spec here is
+self-consistency: sharded attention must reproduce the single-device dense
+softmax result, including fully-masked sequence shards (short positions) and
+the full-model forward must be logit-identical with and without sp.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_multiusers_tpu.parallel import MeshPlan, make_mesh
+from distributed_llama_multiusers_tpu.parallel.ring_attention import (
+    ring_attention,
+    sp_attention,
+)
+
+
+def _dense_reference(q, k, v, mask, scale):
+    scores = jnp.einsum("btkgh,bskh->btkgs", q * scale, k)
+    scores = jnp.where(mask[:, :, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("btkgs,bskh->btkgh", probs, v)
+
+
+@pytest.fixture(scope="module")
+def mesh222():
+    return make_mesh(MeshPlan(dp=2, tp=2, sp=2))
+
+
+@pytest.mark.parametrize("pos", [0, 3, 15, 31])
+def test_sp_attention_matches_dense(mesh222, pos):
+    """Decode-style: T=1 queries at various positions, incl. positions that
+    leave whole sp shards fully masked (pos < S/sp)."""
+    rng = np.random.default_rng(pos)
+    b, t, s, n_kv, g, hd = 4, 1, 32, 2, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, t, n_kv, g, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, n_kv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, n_kv, hd)), jnp.float32)
+    positions = jnp.full((b, t), pos, jnp.int32)
+    scale = 1.0 / hd**0.5
+
+    mask = jnp.arange(s)[None, None, :] <= positions[:, :, None]
+    ref = _dense_reference(q, k, v, mask, scale)
+    got = sp_attention(q, k, v, positions, mesh222, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_sp_attention_per_lane_positions(mesh222):
+    """Every lane at a different position (continuous batching)."""
+    rng = np.random.default_rng(7)
+    b, s, n_kv, g, hd = 4, 64, 2, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, 1, n_kv, g, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, n_kv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, n_kv, hd)), jnp.float32)
+    positions = jnp.asarray([[0], [13], [31], [63]], jnp.int32)
+    scale = 1.0 / hd**0.5
+
+    mask = jnp.arange(s)[None, None, :] <= positions[:, :, None]
+    ref = _dense_reference(q, k, v, mask, scale)
+    got = sp_attention(q, k, v, positions, mesh222, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_matches_causal_dense(mesh222):
+    rng = np.random.default_rng(3)
+    b, t, n_kv, g, hd = 4, 32, 2, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, t, n_kv, g, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, n_kv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, n_kv, hd)), jnp.float32)
+    scale = 1.0 / hd**0.5
+
+    causal = jnp.tril(jnp.ones((t, t), bool))[None]
+    ref = _dense_reference(q, k, v, jnp.broadcast_to(causal, (b, t, t)), scale)
+    got = ring_attention(q, k, v, mesh222, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_full_model_sp_logit_parity(mesh222):
+    """llama_forward with sp-parallel attention == mesh-free forward."""
+    from distributed_llama_multiusers_tpu.models import (
+        init_kv_cache,
+        llama_forward,
+        params_from_random,
+    )
+    from distributed_llama_multiusers_tpu.models.config import LlamaConfig
+    from distributed_llama_multiusers_tpu.parallel.sharding import (
+        cache_shardings,
+        shard_params,
+    )
+
+    config = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        vocab_size=96, seq_len=32,
+    )
+    params = params_from_random(config, seed=11, dtype=jnp.float32)
+    tokens = jnp.asarray([[5, 9, 21], [3, 1, 2], [7, 7, 7], [90, 2, 40]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2]] * 4, jnp.int32)
+
+    cache = init_kv_cache(config, 4)
+    ref_logits, _ = llama_forward(config, params, tokens, positions, cache)
+
+    sp_params = shard_params(params, mesh222)
+    cache = jax.device_put(init_kv_cache(config, 4), cache_shardings(mesh222))
+    got_logits, _ = llama_forward(
+        config, sp_params, tokens, positions, cache, mesh=mesh222
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(ref_logits), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_engine_with_sp_mesh_matches_meshfree(mesh222):
+    """InferenceEngine(mesh=...) — sp attention composed with cache donation,
+    per-lane dynamic-slice prefill, and bucketing — must match the mesh-free
+    engine token-for-token."""
+    from distributed_llama_multiusers_tpu.models import params_from_random
+    from distributed_llama_multiusers_tpu.models.config import LlamaConfig
+    from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+
+    config = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        vocab_size=96, seq_len=32,
+    )
+    params = params_from_random(config, seed=17, dtype=jnp.float32)
+    prompt = [5, 9, 21, 3, 1]
+
+    def run(engine):
+        toks = []
+        _, greedy, pos = engine.prefill(lane=1, tokens=prompt)
+        toks.append(greedy)
+        import numpy as np_
+
+        tokens = np_.zeros(4, np_.int32)
+        positions = np_.zeros(4, np_.int32)
+        for _ in range(4):
+            tokens[1], positions[1] = toks[-1], pos
+            _, greedy = engine.decode(tokens, positions)
+            toks.append(int(greedy[1]))
+            pos += 1
+        return toks
+
+    ref = run(InferenceEngine(config, params, n_lanes=4, prefill_buckets=(4, 8)))
+    got = run(
+        InferenceEngine(
+            config,
+            shard_params(params, mesh222),
+            n_lanes=4,
+            prefill_buckets=(4, 8),
+            mesh=mesh222,
+        )
+    )
+    assert ref == got, (ref, got)
+
+
+def test_ring_attention_train_forward(mesh222):
+    """llama_forward_train with ring attention == dense causal forward."""
+    from distributed_llama_multiusers_tpu.models import (
+        llama_forward_train,
+        params_from_random,
+    )
+    from distributed_llama_multiusers_tpu.models.config import LlamaConfig
+    from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+
+    config = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        vocab_size=96, seq_len=32,
+    )
+    params = params_from_random(config, seed=13, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 96, (4, 16)), jnp.int32)
+
+    ref = llama_forward_train(config, params, tokens)
+    got = llama_forward_train(config, shard_params(params, mesh222), tokens, mesh=mesh222)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4)
