@@ -69,7 +69,9 @@ class SafetensorsIndex:
 def load_config(folder: str, weight_type: int) -> tuple[ModelHeader, dict]:
     with open(os.path.join(folder, "config.json")) as f:
         cfg = json.load(f)
-    arch = {"llama": ArchType.LLAMA, "mistral": ArchType.LLAMA}.get(cfg["model_type"])
+    arch = {"llama": ArchType.LLAMA, "mistral": ArchType.LLAMA, "mixtral": ArchType.LLAMA}.get(
+        cfg["model_type"]
+    )
     if arch is None:
         raise ValueError(f"Unsupported arch type: {cfg['model_type']}")
     act = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}.get(cfg["hidden_act"])
@@ -92,9 +94,9 @@ def load_config(folder: str, weight_type: int) -> tuple[ModelHeader, dict]:
     )
     n_experts = cfg.get("num_local_experts")
     if n_experts:
-        raise NotImplementedError(
-            "MoE checkpoints are not supported yet (the reference runtime also "
-            "only executes dense Llama, src/llm.cpp:21-24)"
+        h.n_experts = int(n_experts)
+        h.n_active_experts = int(
+            cfg.get("num_active_local_experts") or cfg.get("num_experts_per_tok")
         )
     scaling = cfg.get("rope_scaling")
     if scaling is not None and scaling.get("rope_type") in ("llama3",):
@@ -130,9 +132,23 @@ def convert(folder: str, weight_type: int, out_path: str) -> None:
             write_tensor(out, permute_rotary(index.get(f"{pre}.self_attn.k_proj.weight"), n_kv), wt)
             write_tensor(out, index.get(f"{pre}.self_attn.v_proj.weight"), wt)
             write_tensor(out, index.get(f"{pre}.self_attn.o_proj.weight"), wt)
-            write_tensor(out, index.get(f"{pre}.mlp.gate_proj.weight"), wt)  # w1
-            write_tensor(out, index.get(f"{pre}.mlp.down_proj.weight"), wt)  # w2
-            write_tensor(out, index.get(f"{pre}.mlp.up_proj.weight"), wt)  # w3
+            if header.n_experts > 0:
+                # router (framework extension: the reference converter drops
+                # the gate, leaving its MoE files unrunnable) + per-expert
+                # w3/w1/w2 in the reference's expert order (convert-hf.py:66-73
+                # upstream)
+                write_tensor(
+                    out, index.get(f"{pre}.block_sparse_moe.gate.weight"), FloatType.F32
+                )
+                for e in range(header.n_experts):
+                    epre = f"{pre}.block_sparse_moe.experts.{e}"
+                    write_tensor(out, index.get(f"{epre}.w3.weight"), wt)  # up
+                    write_tensor(out, index.get(f"{epre}.w1.weight"), wt)  # gate
+                    write_tensor(out, index.get(f"{epre}.w2.weight"), wt)  # down
+            else:
+                write_tensor(out, index.get(f"{pre}.mlp.gate_proj.weight"), wt)  # w1
+                write_tensor(out, index.get(f"{pre}.mlp.down_proj.weight"), wt)  # w2
+                write_tensor(out, index.get(f"{pre}.mlp.up_proj.weight"), wt)  # w3
             write_tensor(out, index.get(f"{pre}.input_layernorm.weight"), FloatType.F32)
             write_tensor(out, index.get(f"{pre}.post_attention_layernorm.weight"), FloatType.F32)
         write_tensor(out, index.get("model.norm.weight"), FloatType.F32)

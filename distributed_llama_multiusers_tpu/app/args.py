@@ -63,7 +63,7 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
 
 
 def parse_mesh_spec(workers: list[str] | None):
-    """--workers '8' -> tp=8 (reference pure-TP); 'dp2,tp2,sp2' -> explicit."""
+    """--workers '8' -> tp=8 (reference pure-TP); 'dp2,tp2,sp2,ep2' -> explicit."""
     from ..parallel import MeshPlan
 
     if not workers:
@@ -71,7 +71,7 @@ def parse_mesh_spec(workers: list[str] | None):
     spec = workers[0]
     if spec.isdigit():
         return MeshPlan(tp=int(spec))
-    plan = {"dp": 1, "tp": 1, "sp": 1}
+    plan = {"dp": 1, "tp": 1, "sp": 1, "ep": 1}
     for part in spec.split(","):
         for axis in plan:
             if part.startswith(axis):

@@ -64,7 +64,11 @@ def load_stack(args, n_lanes: int | None = None):
         # GSPMD cannot partition the Pallas kernel; sharded forwards take the
         # XLA dequant path (shard_map wrapping is the planned upgrade)
         set_pallas_enabled(False)
-        log("⭕", f"Mesh: dp={plan.dp} tp={plan.tp} sp={plan.sp} over {plan.n_devices} devices")
+        log(
+            "⭕",
+            f"Mesh: dp={plan.dp} tp={plan.tp} sp={plan.sp} ep={plan.ep} "
+            f"over {plan.n_devices} devices",
+        )
     log("💿", "Weights loaded")
 
     from ..quants.codec import FloatType

@@ -214,17 +214,26 @@ class TensorSpec:
     shape: tuple[int, int]  # (d_out, d_in) for matmuls; (1, n) for vectors
     offset: int  # byte offset in file
     n_bytes: int
+    expert: int = -1  # expert index for MoE tensors, -1 for dense
 
 
 def model_tensor_specs(h: ModelHeader) -> list[TensorSpec]:
-    """The full tensor walk of a .m file (src/llm.cpp:447-483)."""
+    """The full tensor walk of a .m file (src/llm.cpp:447-483).
+
+    MoE models (n_experts > 0): the FFN block per layer becomes a router
+    tensor `block_moe_gate` (F32, [n_experts, dim]) followed by per-expert
+    w3, w1, w2 in the reference converter's expert order
+    (convert-hf.py:66-73 upstream). The router tensor is a FRAMEWORK
+    EXTENSION: the reference converter emits expert weights but no gate, and
+    its runtime only executes dense Llama (src/llm.cpp:21-24), so no
+    reference-produced MoE file was ever runnable."""
     specs: list[TensorSpec] = []
     offset = h.header_size
 
-    def add(name: str, layer: int, ftype: int, shape: tuple[int, int]):
+    def add(name: str, layer: int, ftype: int, shape: tuple[int, int], expert: int = -1):
         nonlocal offset
         nb = tensor_bytes(ftype, shape[0] * shape[1])
-        specs.append(TensorSpec(name, layer, ftype, shape, offset, nb))
+        specs.append(TensorSpec(name, layer, ftype, shape, offset, nb, expert))
         offset += nb
 
     wt = h.weight_type
@@ -235,9 +244,16 @@ def model_tensor_specs(h: ModelHeader) -> list[TensorSpec]:
         add("block_matmul_k", l, wt, (kv_dim, dim))
         add("block_matmul_v", l, wt, (kv_dim, dim))
         add("block_matmul_wo", l, wt, (dim, dim))
-        add("block_matmul_w1", l, wt, (hidden, dim))
-        add("block_matmul_w2", l, wt, (dim, hidden))
-        add("block_matmul_w3", l, wt, (hidden, dim))
+        if h.n_experts > 0:
+            add("block_moe_gate", l, FloatType.F32, (h.n_experts, dim))
+            for e in range(h.n_experts):
+                add("block_matmul_w3", l, wt, (hidden, dim), e)
+                add("block_matmul_w1", l, wt, (hidden, dim), e)
+                add("block_matmul_w2", l, wt, (dim, hidden), e)
+        else:
+            add("block_matmul_w1", l, wt, (hidden, dim))
+            add("block_matmul_w2", l, wt, (dim, hidden))
+            add("block_matmul_w3", l, wt, (hidden, dim))
         add("block_rms_norm_0", l, FloatType.F32, (1, dim))
         add("block_rms_norm_1", l, FloatType.F32, (1, dim))
     add("final_rms_norm", 0, FloatType.F32, (1, dim))

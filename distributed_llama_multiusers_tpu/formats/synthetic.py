@@ -24,6 +24,8 @@ def tiny_header(
     weight_type: int = FloatType.Q40,
     rope_type: int = RopeType.LLAMA,
     rope_theta: float = 10000.0,
+    n_experts: int = 0,
+    n_active_experts: int = 0,
 ) -> ModelHeader:
     h = ModelHeader(
         version=0,
@@ -33,6 +35,8 @@ def tiny_header(
         n_layers=n_layers,
         n_heads=n_heads,
         n_kv_heads=n_kv_heads,
+        n_experts=n_experts,
+        n_active_experts=n_active_experts,
         vocab_size=vocab_size,
         seq_len=seq_len,
         orig_seq_len=seq_len,
@@ -80,9 +84,16 @@ def write_synthetic_model(path: str, header: ModelHeader, seed: int = 0, scale: 
             _write_tensor(f, rand((kv_dim, dim)), wt)  # k
             _write_tensor(f, rand((kv_dim, dim)), wt)  # v
             _write_tensor(f, rand((dim, dim)), wt)  # wo
-            _write_tensor(f, rand((hidden, dim)), wt)  # w1 gate
-            _write_tensor(f, rand((dim, hidden)), wt)  # w2 down
-            _write_tensor(f, rand((hidden, dim)), wt)  # w3 up
+            if header.n_experts > 0:
+                _write_tensor(f, rand((header.n_experts, dim)), FloatType.F32)  # router
+                for _ in range(header.n_experts):
+                    _write_tensor(f, rand((hidden, dim)), wt)  # w3 up
+                    _write_tensor(f, rand((hidden, dim)), wt)  # w1 gate
+                    _write_tensor(f, rand((dim, hidden)), wt)  # w2 down
+            else:
+                _write_tensor(f, rand((hidden, dim)), wt)  # w1 gate
+                _write_tensor(f, rand((dim, hidden)), wt)  # w2 down
+                _write_tensor(f, rand((hidden, dim)), wt)  # w3 up
             _write_tensor(f, 1.0 + rand((dim,)), FloatType.F32)  # rms att
             _write_tensor(f, 1.0 + rand((dim,)), FloatType.F32)  # rms ffn
         _write_tensor(f, 1.0 + rand((dim,)), FloatType.F32)  # final rms

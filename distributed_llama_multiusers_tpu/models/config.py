@@ -27,6 +27,13 @@ class LlamaConfig:
     n_experts: int = 0
     n_active_experts: int = 0
 
+    def __post_init__(self):
+        if self.n_experts > 0 and not (1 <= self.n_active_experts <= self.n_experts):
+            raise ValueError(
+                f"MoE config needs 1 <= n_active_experts <= n_experts, got "
+                f"n_active_experts={self.n_active_experts}, n_experts={self.n_experts}"
+            )
+
     @property
     def head_size(self) -> int:
         return self.dim // self.n_heads

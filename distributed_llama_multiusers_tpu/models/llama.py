@@ -41,17 +41,22 @@ class LlamaLayerParams(NamedTuple):
     stores the transpose, [d_out, d_in]; the loader transposes once). Each
     matmul field holds either a dense array or a ``PackedQ40`` (weights kept
     quantized in HBM, dequantized inside the matmul — ops/linear.py).
+
+    MoE models (config.n_experts > 0): w1/w2/w3 gain a leading expert axis
+    ([L, E, d_in, d_out]) and ``moe_gate`` holds the router ([L, dim, E]);
+    dense models carry moe_gate=None.
     """
 
     wq: jnp.ndarray  # [L, dim, dim]
     wk: jnp.ndarray  # [L, dim, kv_dim]
     wv: jnp.ndarray  # [L, dim, kv_dim]
     wo: jnp.ndarray  # [L, dim, dim]
-    w1: jnp.ndarray  # [L, dim, hidden]   gate
-    w2: jnp.ndarray  # [L, hidden, dim]   down
-    w3: jnp.ndarray  # [L, dim, hidden]   up
+    w1: jnp.ndarray  # [L, dim, hidden]   gate     (MoE: [L, E, dim, hidden])
+    w2: jnp.ndarray  # [L, hidden, dim]   down     (MoE: [L, E, hidden, dim])
+    w3: jnp.ndarray  # [L, dim, hidden]   up       (MoE: [L, E, dim, hidden])
     rms_att: jnp.ndarray  # [L, dim]
     rms_ffn: jnp.ndarray  # [L, dim]
+    moe_gate: jnp.ndarray | None = None  # [L, dim, n_experts] router, f32
 
 
 class LlamaParams(NamedTuple):
@@ -91,6 +96,56 @@ def _use_sp(mesh, b: int, t: int | None = None) -> bool:
     if b % mesh.shape.get("dp", 1) != 0:
         return False
     return t is None or t % mesh.shape["sp"] == 0
+
+
+def _moe_router_weights(y: jnp.ndarray, moe_gate: jnp.ndarray, n_active: int) -> jnp.ndarray:
+    """Dense routing weights [B, T, E]: softmax over the top-k router logits,
+    zero for unselected experts (Mixtral semantics; the reference carries
+    n_experts in its header but never executes MoE — SURVEY.md §2.4). The
+    router reads the unquantized normed activations."""
+    logits = jnp.einsum(
+        "btd,de->bte", y.astype(jnp.float32), moe_gate.astype(jnp.float32)
+    )
+    vals, idx = jax.lax.top_k(logits, n_active)
+    w = jax.nn.softmax(vals, axis=-1)  # renormalize over the selected k
+    onehot = jax.nn.one_hot(idx, logits.shape[-1], dtype=w.dtype)  # [B,T,k,E]
+    return jnp.einsum("btk,btke->bte", w, onehot)
+
+
+def _moe_ffn(y, yq, lp, act_fn, n_active: int, maybe_qdq):
+    """Gated-FFN mixture: every expert computes (dense dispatch — static
+    shapes, no data-dependent gather; selection happens through the zero
+    routing weights), outputs combined by router weight. Under an ep-sharded
+    mesh the expert axis of the einsums partitions and XLA inserts the psum
+    at the final reduction.
+
+    PackedQ40 expert stacks take a static per-expert loop ONLY when the
+    Pallas dequant-matmul is live (single-device TPU): on a mesh, slicing the
+    ep-sharded expert axis would all-gather every expert's weights onto every
+    shard, so there the stacked planes are dequantized in place (elementwise,
+    partitions over ep) and flow through the einsum path."""
+    from ..ops.linear import pallas_kernel_active
+    from ..quants.packed import PackedQ40, unpack_q40
+
+    rw = _moe_router_weights(y, lp.moe_gate, n_active)  # [B,T,E] f32
+    w1, w2, w3 = lp.w1, lp.w2, lp.w3
+    if isinstance(w1, PackedQ40):
+        if pallas_kernel_active():
+            out = None
+            for e in range(w1.packed.shape[0]):
+                g = act_fn(matmul(yq, PackedQ40(w1.packed[e], w1.scales[e])))
+                u = matmul(yq, PackedQ40(w3.packed[e], w3.scales[e]))
+                d = matmul(maybe_qdq(g * u), PackedQ40(w2.packed[e], w2.scales[e]))
+                term = d * rw[..., e : e + 1].astype(d.dtype)
+                out = term if out is None else out + term
+            return out
+        w1 = unpack_q40(w1, yq.dtype)
+        w2 = unpack_q40(w2, yq.dtype)
+        w3 = unpack_q40(w3, yq.dtype)
+    g = act_fn(jnp.einsum("btd,edh->bteh", yq, w1))
+    u = jnp.einsum("btd,edh->bteh", yq, w3)
+    d = jnp.einsum("bteh,ehd->bted", maybe_qdq(g * u), w2)
+    return jnp.einsum("bted,bte->btd", d, rw.astype(d.dtype))
 
 
 def _dense_attention(qf, kf, vf, mask, scale):
@@ -175,9 +230,12 @@ def llama_forward(
 
         y = rms_norm(x, lp.rms_ffn, eps)
         yq = maybe_qdq(y)
-        g = act_fn(matmul(yq, lp.w1))
-        u = matmul(yq, lp.w3)
-        d = matmul(maybe_qdq(g * u), lp.w2)
+        if h_cfg.n_experts > 0:
+            d = _moe_ffn(y, yq, lp, act_fn, h_cfg.n_active_experts, maybe_qdq)
+        else:
+            g = act_fn(matmul(yq, lp.w1))
+            u = matmul(yq, lp.w3)
+            d = matmul(maybe_qdq(g * u), lp.w2)
         x = x + maybe_qdq(d)
 
         return x, (k_cache, v_cache)
@@ -238,7 +296,10 @@ def llama_forward_train(
         x = x + matmul(attn.astype(dtype), lp.wo)
 
         y = rms_norm(x, lp.rms_ffn, eps)
-        x = x + matmul(act_fn(matmul(y, lp.w1)) * matmul(y, lp.w3), lp.w2)
+        if config.n_experts > 0:
+            x = x + _moe_ffn(y, y, lp, act_fn, config.n_active_experts, lambda v: v)
+        else:
+            x = x + matmul(act_fn(matmul(y, lp.w1)) * matmul(y, lp.w3), lp.w2)
         return x, None
 
     x, _ = jax.lax.scan(layer_step, x, params.layers)

@@ -65,9 +65,17 @@ _TENSOR_NAME_MAP = {
 def read_m_tensors(path: str, header: ModelHeader) -> dict:
     """Read a .m file as dequantized f32 arrays in file orientation
     ([d_out, d_in] matmuls): embedding, rms_final, wcls plus per-layer lists
-    wq,wk,wv,wo,w1,w2,w3,rms_att,rms_ffn (order: src/llm.cpp:447-483)."""
+    wq,wk,wv,wo,w1,w2,w3,rms_att,rms_ffn (order: src/llm.cpp:447-483).
+
+    MoE files additionally yield per-layer "moe_gate" [n_experts, dim] and
+    w1/w2/w3 as per-layer [n_experts, d_out, d_in] stacks."""
     config = LlamaConfig.from_header(header)
-    w: dict = {k: [None] * config.n_layers for k in _TENSOR_NAME_MAP.values()}
+    L, E = config.n_layers, config.n_experts
+    w: dict = {k: [None] * L for k in _TENSOR_NAME_MAP.values()}
+    if E > 0:
+        w["moe_gate"] = [None] * L
+        for key in ("w1", "w2", "w3"):
+            w[key] = [[None] * E for _ in range(L)]
     for spec, raw in iter_model_tensors(path, header):
         x = _decode_tensor(raw, spec.float_type, spec.shape)
         if spec.name == "embedding":
@@ -76,9 +84,17 @@ def read_m_tensors(path: str, header: ModelHeader) -> dict:
             w["rms_final"] = x.reshape(-1)
         elif spec.name == "final_matmul_logits":
             w["wcls"] = x
+        elif spec.name == "block_moe_gate":
+            w["moe_gate"][spec.layer] = x
         else:
             key = _TENSOR_NAME_MAP[spec.name]
-            w[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
+            if spec.expert >= 0:
+                w[key][spec.layer][spec.expert] = x
+            else:
+                w[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
+    if E > 0:
+        for key in ("w1", "w2", "w3"):
+            w[key] = [np.stack(mats) for mats in w[key]]  # [E, d_out, d_in] per layer
     return w
 
 
@@ -131,12 +147,21 @@ def load_params_from_m(
         if key.startswith("rms"):
             stacked[key] = np.stack(mats)
         else:
-            stacked[key] = np.stack([m.T for m in mats])  # -> [L, d_in, d_out]
+            # -> [L, d_in, d_out] (MoE ffn: [L, E, d_in, d_out])
+            stacked[key] = np.stack([np.swapaxes(m, -1, -2) for m in mats])
+
+    moe_gate = None
+    if config.n_experts > 0:
+        # [L, n_experts, dim] -> [L, dim, n_experts] for y @ gate
+        moe_gate = np.swapaxes(np.stack(raw_w["moe_gate"]), -1, -2)
 
     cast = _cast_fn(dtype)
     cos, sin = _rope_cache(config)
 
     layers = LlamaLayerParams(
+        moe_gate=(
+            put("moe_gate", moe_gate).astype(jnp.float32) if moe_gate is not None else None
+        ),
         wq=put("wq", cast(stacked["wq"])).astype(dtype),
         wk=put("wk", cast(stacked["wk"])).astype(dtype),
         wv=put("wv", cast(stacked["wv"])).astype(dtype),
@@ -174,10 +199,15 @@ def load_params_from_m_quantized(
     norms are always dense (gather/elementwise ops want plain arrays)."""
     config = LlamaConfig.from_header(header)
     put = device_put_fn or (lambda name, x: jnp.asarray(x))
-    L = config.n_layers
+    L, E = config.n_layers, config.n_experts
+
+    def empty(key):
+        if E > 0 and key in ("w1", "w2", "w3"):
+            return [[None] * E for _ in range(L)]
+        return [None] * L
 
     dense: dict = {}
-    packed_w: dict = {k: [None] * L for k in _MATMUL_KEYS}
+    packed_w: dict = {k: empty(k) for k in _MATMUL_KEYS}
     for spec, raw in iter_model_tensors(path, header):
         is_matmul = spec.name.startswith("block_matmul_") or spec.name == "final_matmul_logits"
         if is_matmul and spec.float_type == FloatType.Q40:
@@ -186,7 +216,10 @@ def load_params_from_m_quantized(
                 dense["wcls"] = ("q40", pk, sc)
             else:
                 key = _TENSOR_NAME_MAP[spec.name]
-                packed_w[key][spec.layer] = (pk, sc)
+                if spec.expert >= 0:
+                    packed_w[key][spec.layer][spec.expert] = (pk, sc)
+                else:
+                    packed_w[key][spec.layer] = (pk, sc)
         else:
             x = _decode_tensor(raw, spec.float_type, spec.shape)
             if spec.name == "embedding":
@@ -195,35 +228,64 @@ def load_params_from_m_quantized(
                 dense["rms_final"] = x.reshape(-1)
             elif spec.name == "final_matmul_logits":
                 dense["wcls"] = ("dense", x.T)
+            elif spec.name == "block_moe_gate":
+                dense.setdefault("moe_gate", [None] * L)
+                dense["moe_gate"][spec.layer] = x
             else:
                 key = _TENSOR_NAME_MAP[spec.name]
-                dense.setdefault(key, [None] * L)
-                dense[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
+                dense.setdefault(key, [None] * L if spec.expert < 0 else empty(key))
+                if spec.expert >= 0:
+                    dense[key][spec.layer][spec.expert] = x
+                else:
+                    dense[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
 
     cast = _cast_fn(dtype)
 
+    def _flatten(entries):
+        """Per-layer entries, or per-layer-per-expert lists, flattened."""
+        for m in entries:
+            if isinstance(m, list):
+                yield from m
+            else:
+                yield m
+
+    def _stack_tree(entries, pick):
+        """np.stack over layers (and experts for MoE nested lists)."""
+        if isinstance(entries[0], list):
+            return np.stack([np.stack([pick(m) for m in layer]) for layer in entries])
+        return np.stack([pick(m) for m in entries])
+
     def stack_packed(key: str):
         mats = packed_w[key]
-        if all(m is not None for m in mats):
+        flat = list(_flatten(mats))
+        if all(m is not None for m in flat):
             return PackedQ40(
-                packed=put(key, np.stack([m[0] for m in mats])),
-                scales=put(key + ".scales", np.stack([m[1] for m in mats])),
+                packed=put(key, _stack_tree(mats, lambda m: m[0])),
+                scales=put(key + ".scales", _stack_tree(mats, lambda m: m[1])),
             )
-        if any(m is not None for m in mats):
+        if any(m is not None for m in flat):
             # float_type is per-tensor in the .m header, so this is encodable
             # but no converter emits it; fail clearly rather than stack holes
             raise ValueError(
-                f"{key}: layers mix Q40 and non-Q40 float types; "
-                "per-layer mixed quantization is not supported"
+                f"{key}: tensors mix Q40 and non-Q40 float types; "
+                "mixed quantization is not supported"
             )
         # dense fallback (non-Q40 model): same path as load_params_from_m
-        return put(key, cast(np.stack([m.T for m in dense[key]]))).astype(dtype)
+        return put(
+            key, cast(_stack_tree(dense[key], lambda m: np.swapaxes(m, -1, -2)))
+        ).astype(dtype)
 
     cos, sin = _rope_cache(config)
+    moe_gate = None
+    if E > 0:
+        moe_gate = put(
+            "moe_gate", np.swapaxes(np.stack(dense["moe_gate"]), -1, -2)
+        ).astype(jnp.float32)
     layers = LlamaLayerParams(
         **{k: stack_packed(k) for k in _MATMUL_KEYS},
         rms_att=put("rms_att", np.stack(dense["rms_att"])).astype(jnp.float32),
         rms_ffn=put("rms_ffn", np.stack(dense["rms_ffn"])).astype(jnp.float32),
+        moe_gate=moe_gate,
     )
     wcls_entry = dense["wcls"]
     if wcls_entry[0] == "q40":
@@ -292,16 +354,23 @@ def params_from_random(
         return jnp.asarray(w, dtype=dtype) if to_device else w.astype(np_dtype)
 
     cos, sin = _rope_cache(config)
+    E = config.n_experts
+    ffn_lead = (L, E) if E > 0 else (L,)
     layers = LlamaLayerParams(
         wq=r(L, dim, dim),
         wk=r(L, dim, kv_dim),
         wv=r(L, dim, kv_dim),
         wo=r(L, dim, dim),
-        w1=r(L, dim, hidden),
-        w2=r(L, hidden, dim),
-        w3=r(L, dim, hidden),
+        w1=r(*ffn_lead, dim, hidden),
+        w2=r(*ffn_lead, hidden, dim),
+        w3=r(*ffn_lead, dim, hidden),
         rms_att=arr(np.ones((L, dim), np.float32)),
         rms_ffn=arr(np.ones((L, dim), np.float32)),
+        moe_gate=(
+            arr(rng.standard_normal((L, dim, E), dtype=np.float32) * scale)
+            if E > 0
+            else None
+        ),
     )
     return LlamaParams(
         embedding=r(vocab, dim),

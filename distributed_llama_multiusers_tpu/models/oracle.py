@@ -61,6 +61,11 @@ class OracleLlama:
         inv = 1.0 / np.sqrt(np.mean(x.astype(np.float32) ** 2) + self.c.norm_epsilon)
         return (x * inv * w).astype(np.float32)
 
+    def _act(self, g: np.ndarray) -> np.ndarray:
+        if self.c.hidden_act == HiddenAct.SILU:
+            return g / (1.0 + np.exp(-g))
+        return 0.5 * g * (1.0 + np.tanh(0.797884560802865 * g * (1.0 + 0.044715 * g * g)))
+
     def _rope(self, x: np.ndarray, pos: int) -> np.ndarray:
         # x: [n_heads_x, head_size], interleaved pairs
         h, d = x.shape
@@ -108,13 +113,24 @@ class OracleLlama:
 
             y = self._rms(x, self.w["rms_ffn"][l])
             yq = qdq(y)
-            g = self.w["w1"][l] @ yq
-            u = self.w["w3"][l] @ yq
-            if c.hidden_act == HiddenAct.SILU:
-                g = g / (1.0 + np.exp(-g))
+            if c.n_experts > 0:
+                # top-k routing, softmax over selected logits (Mixtral
+                # semantics; the reference never executes MoE — SURVEY.md §2.4)
+                gate = self.w["moe_gate"][l] @ y  # router reads unquantized y
+                top = np.argsort(-gate)[: c.n_active_experts]
+                ew = np.exp(gate[top] - gate[top].max())
+                ew = ew / ew.sum()
+                d = np.zeros_like(x)
+                for e, we in zip(top, ew):
+                    g = self.w["w1"][l][e] @ yq
+                    u = self.w["w3"][l][e] @ yq
+                    g = self._act(g)
+                    d = d + we * (self.w["w2"][l][e] @ qdq(g * u))
             else:
-                g = 0.5 * g * (1.0 + np.tanh(0.797884560802865 * g * (1.0 + 0.044715 * g * g)))
-            d = self.w["w2"][l] @ qdq(g * u)
+                g = self.w["w1"][l] @ yq
+                u = self.w["w3"][l] @ yq
+                g = self._act(g)
+                d = self.w["w2"][l] @ qdq(g * u)
             x = x + qdq(d)
 
         y = self._rms(x, self.w["rms_final"])

@@ -50,6 +50,11 @@ def _pallas_q40_matmul():
     return q40_matmul_pallas
 
 
+def pallas_kernel_active() -> bool:
+    """Whether PackedQ40 matmuls currently route to the Pallas kernel."""
+    return _pallas_enabled and _pallas_q40_matmul() is not None
+
+
 def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """y = x @ w for dense [.., d_in, d_out] arrays or PackedQ40 weights."""
     if isinstance(w, PackedQ40):

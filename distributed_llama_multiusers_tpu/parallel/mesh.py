@@ -7,6 +7,7 @@ Here a node is a TPU chip in a `jax.sharding.Mesh` with named axes:
     dp — data/batch (request lanes)         [reference: none — single replica]
     tp — tensor parallel (heads / ffn dim)  [reference: the core strategy]
     sp — sequence parallel (KV cache S)     [reference: absent, §5.7]
+    ep — expert parallel (MoE experts)      [reference: header fields only, §2.4]
 
 All collectives ride ICI via GSPMD; the bootstrap/config/weight-shipping
 protocol of nn-network.cpp collapses into device_put with shardings.
@@ -22,7 +23,7 @@ from jax.sharding import Mesh
 
 from ..models.config import LlamaConfig
 
-AXES = ("dp", "tp", "sp")
+AXES = ("dp", "tp", "sp", "ep")
 
 
 @dataclass(frozen=True)
@@ -30,22 +31,25 @@ class MeshPlan:
     dp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.tp * self.sp * self.ep
 
 
 def make_mesh(plan: MeshPlan | None = None, devices=None) -> Mesh:
-    """Build a Mesh with axes (dp, tp, sp). With no plan, all devices go to tp
-    (the reference's pure-TP layout)."""
+    """Build a Mesh with axes (dp, tp, sp, ep). With no plan, all devices go
+    to tp (the reference's pure-TP layout)."""
     if devices is None:
         devices = jax.devices()
     if plan is None:
         plan = MeshPlan(tp=len(devices))
     if plan.n_devices > len(devices):
         raise ValueError(f"mesh plan needs {plan.n_devices} devices, have {len(devices)}")
-    devs = np.asarray(devices[: plan.n_devices]).reshape(plan.dp, plan.tp, plan.sp)
+    devs = np.asarray(devices[: plan.n_devices]).reshape(
+        plan.dp, plan.tp, plan.sp, plan.ep
+    )
     return Mesh(devs, AXES)
 
 
@@ -65,3 +69,10 @@ def validate_mesh_for_config(config: LlamaConfig, plan: MeshPlan) -> None:
         raise ValueError("vocab_size not divisible by tp")
     if config.seq_len % sp != 0:
         raise ValueError(f"seq_len={config.seq_len} not divisible by sp={sp}")
+    if plan.ep > 1:
+        if config.n_experts <= 0:
+            raise ValueError(f"ep={plan.ep} needs an MoE model (n_experts > 0)")
+        if config.n_experts % plan.ep != 0:
+            raise ValueError(
+                f"n_experts={config.n_experts} not divisible by ep={plan.ep}"
+            )

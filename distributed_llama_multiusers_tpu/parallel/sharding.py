@@ -43,17 +43,39 @@ def param_shardings(mesh: Mesh, params: LlamaParams | None = None) -> LlamaParam
             return PackedQ40(packed=ns(*spec), scales=ns(*spec))
         return ns(*spec)
 
-    lp = params.layers if params is not None else LlamaLayerParams(*[None] * 9)
+    lp = (
+        params.layers
+        if params is not None
+        else LlamaLayerParams(*([None] * len(LlamaLayerParams._fields)))
+    )
+
+    def ffn_rank(field):
+        x = field.packed if isinstance(field, PackedQ40) else field
+        return 3 if x is None else x.ndim
+
+    moe = params is not None and ffn_rank(lp.w1) == 4
+
+    def ffn(field, last_axis_tp: bool):
+        # dense ffn: [L, d_in, d_out]; MoE: [L, E, d_in, d_out] — experts
+        # shard over ep, the d dimension over tp as in the dense case
+        # (sliceRowMatmul/sliceColMatmul, src/nn/nn-core.cpp:207-230)
+        if moe:
+            spec = (None, "ep", None, "tp") if last_axis_tp else (None, "ep", "tp", None)
+        else:
+            spec = (None, None, "tp") if last_axis_tp else (None, "tp", None)
+        return w(field, *spec)
+
     layers = LlamaLayerParams(
         wq=w(lp.wq, None, None, "tp"),
         wk=w(lp.wk, None, None, "tp"),
         wv=w(lp.wv, None, None, "tp"),
         wo=w(lp.wo, None, "tp", None),
-        w1=w(lp.w1, None, None, "tp"),
-        w2=w(lp.w2, None, "tp", None),
-        w3=w(lp.w3, None, None, "tp"),
+        w1=ffn(lp.w1, True),
+        w2=ffn(lp.w2, False),
+        w3=ffn(lp.w3, True),
         rms_att=ns(None, None),
         rms_ffn=ns(None, None),
+        moe_gate=ns(None, None, None) if moe else None,
     )
     return LlamaParams(
         # embedding replicated: the reference keeps it root-only
