@@ -262,32 +262,48 @@ def llama_forward_train(
     parallel/ring_attention.ring_attention) — long-context training/prefill
     never materializes the full [T, T] score matrix per device."""
     b, t = tokens.shape
+    eps = config.norm_epsilon
+    use_sp = _use_sp(mesh, b, t)
+
+    x = params.embedding[tokens]
+    layer_step = train_layer_step_fn(
+        config, params.rope_cos, params.rope_sin, mesh=mesh if use_sp else None
+    )
+    x, _ = jax.lax.scan(layer_step, x, params.layers)
+    y = rms_norm(x, params.rms_final, eps)
+    return matmul(y, params.wcls).astype(jnp.float32)
+
+
+def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None):
+    """The causal full-sequence transformer layer as a lax.scan step
+    ``(x [B,T,dim], lp) -> (x, None)`` — shared by llama_forward_train and
+    the pipeline-parallel schedule (parallel/pipeline.py). With ``mesh``,
+    attention runs as ring attention over sp (caller must guarantee whole
+    shards; pipeline stages pass mesh=None — shard_map does not nest)."""
     n_heads, n_kv, hd = config.n_heads, config.n_kv_heads, config.head_size
     eps = config.norm_epsilon
     act_fn = silu if config.hidden_act == HiddenAct.SILU else gelu
-    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
-    use_sp = _use_sp(mesh, b, t)
-    causal = None if use_sp else jnp.tril(jnp.ones((t, t), bool))
-
-    x = params.embedding[tokens]
 
     def layer_step(x, lp):
+        b, t = x.shape[0], x.shape[1]
         dtype = x.dtype
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
         y = rms_norm(x, lp.rms_att, eps)
         q = matmul(y, lp.wq).reshape(b, t, n_heads, hd)
         k = matmul(y, lp.wk).reshape(b, t, n_kv, hd)
         v = matmul(y, lp.wv).reshape(b, t, n_kv, hd)
-        q = apply_rope(q, params.rope_cos, params.rope_sin, positions)
-        k = apply_rope(k, params.rope_cos, params.rope_sin, positions)
+        q = apply_rope(q, rope_cos, rope_sin, positions)
+        k = apply_rope(k, rope_cos, rope_sin, positions)
 
         group = n_heads // n_kv
         qf = q.astype(jnp.float32).reshape(b, t, n_kv, group, hd)
         scale = 1.0 / float(hd) ** 0.5
-        if use_sp:
+        if mesh is not None:
             from ..parallel.ring_attention import ring_attention
 
             attn = ring_attention(qf, k.astype(jnp.float32), v.astype(jnp.float32), mesh, scale)
         else:
+            causal = jnp.tril(jnp.ones((t, t), bool))
             attn = _dense_attention(
                 qf, k.astype(jnp.float32), v.astype(jnp.float32),
                 jnp.broadcast_to(causal[None], (b, t, t)), scale,
@@ -302,6 +318,4 @@ def llama_forward_train(
             x = x + matmul(act_fn(matmul(y, lp.w1)) * matmul(y, lp.w3), lp.w2)
         return x, None
 
-    x, _ = jax.lax.scan(layer_step, x, params.layers)
-    y = rms_norm(x, params.rms_final, eps)
-    return matmul(y, params.wcls).astype(jnp.float32)
+    return layer_step

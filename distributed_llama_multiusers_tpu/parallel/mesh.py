@@ -8,6 +8,7 @@ Here a node is a TPU chip in a `jax.sharding.Mesh` with named axes:
     tp — tensor parallel (heads / ffn dim)  [reference: the core strategy]
     sp — sequence parallel (KV cache S)     [reference: absent, §5.7]
     ep — expert parallel (MoE experts)      [reference: header fields only, §2.4]
+    pp — pipeline parallel (layer stages)   [reference: explicitly absent, §2.4]
 
 All collectives ride ICI via GSPMD; the bootstrap/config/weight-shipping
 protocol of nn-network.cpp collapses into device_put with shardings.
@@ -23,7 +24,7 @@ from jax.sharding import Mesh
 
 from ..models.config import LlamaConfig
 
-AXES = ("dp", "tp", "sp", "ep")
+AXES = ("dp", "pp", "tp", "sp", "ep")
 
 
 @dataclass(frozen=True)
@@ -32,15 +33,16 @@ class MeshPlan:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.tp * self.sp * self.ep
+        return self.dp * self.tp * self.sp * self.ep * self.pp
 
 
 def make_mesh(plan: MeshPlan | None = None, devices=None) -> Mesh:
-    """Build a Mesh with axes (dp, tp, sp, ep). With no plan, all devices go
-    to tp (the reference's pure-TP layout)."""
+    """Build a Mesh with axes (dp, pp, tp, sp, ep). With no plan, all devices
+    go to tp (the reference's pure-TP layout)."""
     if devices is None:
         devices = jax.devices()
     if plan is None:
@@ -48,7 +50,7 @@ def make_mesh(plan: MeshPlan | None = None, devices=None) -> Mesh:
     if plan.n_devices > len(devices):
         raise ValueError(f"mesh plan needs {plan.n_devices} devices, have {len(devices)}")
     devs = np.asarray(devices[: plan.n_devices]).reshape(
-        plan.dp, plan.tp, plan.sp, plan.ep
+        plan.dp, plan.pp, plan.tp, plan.sp, plan.ep
     )
     return Mesh(devs, AXES)
 
@@ -69,6 +71,8 @@ def validate_mesh_for_config(config: LlamaConfig, plan: MeshPlan) -> None:
         raise ValueError("vocab_size not divisible by tp")
     if config.seq_len % sp != 0:
         raise ValueError(f"seq_len={config.seq_len} not divisible by sp={sp}")
+    if plan.pp > 1 and config.n_layers % plan.pp != 0:
+        raise ValueError(f"n_layers={config.n_layers} not divisible by pp={plan.pp}")
     if plan.ep > 1:
         if config.n_experts <= 0:
             raise ValueError(f"ep={plan.ep} needs an MoE model (n_experts > 0)")

@@ -1,0 +1,97 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the pp axis.
+
+The reference explicitly has NO pipeline parallelism — its paper contrasts
+the TP design with Petals/llama.cpp-MPI layer splitting (SURVEY.md §2.4) —
+so this is a capability extension, built TPU-first:
+
+- Layer-stacked params shard their leading [n_layers] axis over ``pp``
+  (each device owns n_layers/pp consecutive layers).
+- The batch splits into M microbatches; over M + pp - 1 ticks, stage d
+  processes microbatch s - d while activations hop stage-to-stage via
+  lax.ppermute — compute on different stages overlaps across microbatches.
+- shard_map is manual over pp ONLY (``axis_names={"pp"}``): dp/tp/ep stay
+  GSPMD-auto inside each stage, so pipeline composes with tensor and expert
+  parallelism without hand-written collectives. (sp ring attention does not
+  nest inside the pipeline — shard_map in shard_map — so stages use dense
+  attention; pp+sp is validated as separate meshes, see __graft_entry__.)
+
+Embedding and the final norm/logits run outside the pipeline under plain
+GSPMD; only the layer stack is staged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.config import LlamaConfig
+from ..models.llama import LlamaParams, train_layer_step_fn
+from ..ops.linear import matmul
+from ..ops.norm import rms_norm
+
+
+def pipeline_forward_train(
+    config: LlamaConfig,
+    params: LlamaParams,
+    tokens: jnp.ndarray,  # [B, T] int32
+    mesh: Mesh,
+    n_microbatches: int | None = None,
+) -> jnp.ndarray:
+    """Causal full-sequence forward with the layer stack pipelined over pp.
+    Returns logits [B, T, vocab] f32; matches llama_forward_train exactly."""
+    n_pp = mesh.shape["pp"]
+    b, t = tokens.shape
+    if n_pp <= 1:
+        from ..models.llama import llama_forward_train
+
+        return llama_forward_train(config, params, tokens, mesh=mesh)
+    m = n_microbatches or n_pp
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    if config.n_layers % n_pp != 0:
+        raise ValueError(f"n_layers={config.n_layers} not divisible by pp={n_pp}")
+    mb = b // m
+
+    x = params.embedding[tokens]  # [B, T, dim] — plain GSPMD
+    xmb = x.reshape(m, mb, t, x.shape[-1])
+    layer_step = train_layer_step_fn(config, params.rope_cos, params.rope_sin)
+
+    def inner(layers_local, xall):
+        d = jax.lax.axis_index("pp")
+        is_first = d == 0
+        is_last = d == n_pp - 1
+
+        def stage(xin):
+            return jax.lax.scan(layer_step, xin, layers_local)[0]
+
+        state = jnp.zeros_like(xall[0])
+        outs = jnp.zeros_like(xall)
+        # M + pp - 1 ticks: stage d works on microbatch s - d at tick s
+        for s in range(m + n_pp - 1):
+            inject = xall[min(s, m - 1)]
+            state_in = jnp.where(is_first, jnp.where(s < m, 1.0, 0.0) * inject, state)
+            y = stage(state_in)
+            out_idx = s - (n_pp - 1)
+            if 0 <= out_idx < m:
+                outs = outs.at[out_idx].set(jnp.where(is_last, y, outs[out_idx]))
+            state = jax.lax.ppermute(
+                y, "pp", [(i, i + 1) for i in range(n_pp - 1)]
+            )
+        # replicate the last stage's result over pp
+        return jax.lax.psum(jnp.where(is_last, outs, 0.0), "pp")
+
+    layer_specs = jax.tree.map(lambda _: P("pp"), params.layers)
+    out = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=P(),
+        axis_names={"pp"},
+        check_vma=False,
+    )(params.layers, xmb)
+
+    x = out.reshape(b, t, -1)
+    y = rms_norm(x, params.rms_final, config.norm_epsilon)
+    return matmul(y, params.wcls).astype(jnp.float32)

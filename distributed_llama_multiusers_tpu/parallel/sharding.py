@@ -60,22 +60,24 @@ def param_shardings(mesh: Mesh, params: LlamaParams | None = None) -> LlamaParam
         # shard over ep, the d dimension over tp as in the dense case
         # (sliceRowMatmul/sliceColMatmul, src/nn/nn-core.cpp:207-230)
         if moe:
-            spec = (None, "ep", None, "tp") if last_axis_tp else (None, "ep", "tp", None)
+            spec = ("pp", "ep", None, "tp") if last_axis_tp else ("pp", "ep", "tp", None)
         else:
-            spec = (None, None, "tp") if last_axis_tp else (None, "tp", None)
+            spec = ("pp", None, "tp") if last_axis_tp else ("pp", "tp", None)
         return w(field, *spec)
 
+    # every layer-stacked leaf leads with the pp axis (layer stages); with
+    # pp=1 that sharding is a no-op
     layers = LlamaLayerParams(
-        wq=w(lp.wq, None, None, "tp"),
-        wk=w(lp.wk, None, None, "tp"),
-        wv=w(lp.wv, None, None, "tp"),
-        wo=w(lp.wo, None, "tp", None),
+        wq=w(lp.wq, "pp", None, "tp"),
+        wk=w(lp.wk, "pp", None, "tp"),
+        wv=w(lp.wv, "pp", None, "tp"),
+        wo=w(lp.wo, "pp", "tp", None),
         w1=ffn(lp.w1, True),
         w2=ffn(lp.w2, False),
         w3=ffn(lp.w3, True),
-        rms_att=ns(None, None),
-        rms_ffn=ns(None, None),
-        moe_gate=ns(None, None, None) if moe else None,
+        rms_att=ns("pp", None),
+        rms_ffn=ns("pp", None),
+        moe_gate=ns("pp", None, None) if moe else None,
     )
     return LlamaParams(
         # embedding replicated: the reference keeps it root-only
