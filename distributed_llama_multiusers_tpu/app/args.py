@@ -71,7 +71,7 @@ def parse_mesh_spec(workers: list[str] | None):
     spec = workers[0]
     if spec.isdigit():
         return MeshPlan(tp=int(spec))
-    plan = {"dp": 1, "tp": 1, "sp": 1, "ep": 1}
+    plan = {"dp": 1, "tp": 1, "sp": 1, "ep": 1, "pp": 1}
     for part in spec.split(","):
         for axis in plan:
             if part.startswith(axis):

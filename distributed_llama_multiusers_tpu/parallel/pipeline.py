@@ -2,29 +2,34 @@
 
 The reference explicitly has NO pipeline parallelism — its paper contrasts
 the TP design with Petals/llama.cpp-MPI layer splitting (SURVEY.md §2.4) —
-so this is a capability extension, built TPU-first:
+so this is a capability extension, built TPU-first as a pure-GSPMD program
+(the schedule XLA's SPMD partitioner was designed for, no manual
+collectives):
 
-- Layer-stacked params shard their leading [n_layers] axis over ``pp``
-  (each device owns n_layers/pp consecutive layers).
-- The batch splits into M microbatches; over M + pp - 1 ticks, stage d
-  processes microbatch s - d while activations hop stage-to-stage via
-  lax.ppermute — compute on different stages overlaps across microbatches.
-- shard_map is manual over pp ONLY (``axis_names={"pp"}``): dp/tp/ep stay
-  GSPMD-auto inside each stage, so pipeline composes with tensor and expert
-  parallelism without hand-written collectives. (sp ring attention does not
-  nest inside the pipeline — shard_map in shard_map — so stages use dense
-  attention; pp+sp is validated as separate meshes, see __graft_entry__.)
+- The [n_layers] stack reshapes to [pp, n_layers/pp, ...] and shards its
+  stage axis over ``pp``; each device holds n_layers/pp consecutive layers.
+- The batch splits into M microbatches. One tick = every stage running its
+  layer block on its current microbatch simultaneously — expressed as a
+  ``vmap`` over the stage axis, which XLA partitions across pp.
+- Between ticks, activations hop stage-to-stage via ``jnp.roll`` on the
+  stage axis; on a pp-sharded array XLA lowers this to a CollectivePermute
+  over ICI. Over M + pp - 1 ticks every microbatch visits every stage
+  (stage d sees microbatch s - d at tick s): the GPipe fill/drain schedule.
+- dp/tp/ep compose freely: inside a tick the per-stage compute is ordinary
+  GSPMD, so tensor-parallel weights keep their tp sharding and the usual
+  psum at wo/w2 boundaries. (sp ring attention does not nest — stages run
+  dense attention; pp+sp remain separate meshes, see __graft_entry__.)
 
 Embedding and the final norm/logits run outside the pipeline under plain
-GSPMD; only the layer stack is staged.
+GSPMD; only the layer stack is staged. Everything differentiates — the
+backward pass is the same schedule transposed, with reversed hops.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import LlamaConfig
 from ..models.llama import LlamaParams, train_layer_step_fn
@@ -54,44 +59,58 @@ def pipeline_forward_train(
         raise ValueError(f"n_layers={config.n_layers} not divisible by pp={n_pp}")
     mb = b // m
 
+    def act_sharded(x):
+        # activations: stage axis over pp, microbatch lanes over dp
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("pp", "dp"))
+        )
+
+    # [L, ...] -> [pp, L/pp, ...], stage axis sharded over pp with each
+    # weight's own trailing spec (tp/ep factors) preserved — the reshape is a
+    # relabeling of the already-P("pp", ...)-sharded layer axis
+    # (parallel/sharding.py), not a reshuffle.
+    from .sharding import param_shardings
+
+    layer_specs = param_shardings(mesh, params).layers
+
+    def to_stage(w, s):
+        spec = s.spec
+        staged = w.reshape(n_pp, config.n_layers // n_pp, *w.shape[1:])
+        return jax.lax.with_sharding_constraint(
+            staged, NamedSharding(mesh, P(spec[0], None, *spec[1:]))
+        )
+
+    stages = jax.tree.map(to_stage, params.layers, layer_specs)
+
     x = params.embedding[tokens]  # [B, T, dim] — plain GSPMD
-    xmb = x.reshape(m, mb, t, x.shape[-1])
+    xmb = jax.lax.with_sharding_constraint(
+        x.reshape(m, mb, t, x.shape[-1]), NamedSharding(mesh, P(None, "dp"))
+    )
     layer_step = train_layer_step_fn(config, params.rope_cos, params.rope_sin)
 
-    def inner(layers_local, xall):
-        d = jax.lax.axis_index("pp")
-        is_first = d == 0
-        is_last = d == n_pp - 1
+    def stage_fn(layers_local, xin):
+        return jax.lax.scan(layer_step, xin, layers_local)[0]
 
-        def stage(xin):
-            return jax.lax.scan(layer_step, xin, layers_local)[0]
+    # one tick: all pp stages run their layer block at once; XLA partitions
+    # the vmapped compute along the sharded stage axis
+    vstage = jax.vmap(stage_fn, in_axes=(0, 0))
 
-        state = jnp.zeros_like(xall[0])
-        outs = jnp.zeros_like(xall)
-        # M + pp - 1 ticks: stage d works on microbatch s - d at tick s
-        for s in range(m + n_pp - 1):
-            inject = xall[min(s, m - 1)]
-            state_in = jnp.where(is_first, jnp.where(s < m, 1.0, 0.0) * inject, state)
-            y = stage(state_in)
-            out_idx = s - (n_pp - 1)
-            if 0 <= out_idx < m:
-                outs = outs.at[out_idx].set(jnp.where(is_last, y, outs[out_idx]))
-            state = jax.lax.ppermute(
-                y, "pp", [(i, i + 1) for i in range(n_pp - 1)]
-            )
-        # replicate the last stage's result over pp
-        return jax.lax.psum(jnp.where(is_last, outs, 0.0), "pp")
+    state = act_sharded(jnp.zeros((n_pp, mb, t, x.shape[-1]), x.dtype))
+    outs = jnp.zeros((m, mb, t, x.shape[-1]), x.dtype)
+    # GPipe fill/drain: M + pp - 1 ticks, stage d works microbatch s - d.
+    # s is a Python int, so injection/collection are static slices.
+    for s in range(m + n_pp - 1):
+        if s < m:
+            state = state.at[0].set(xmb[s])
+        y = act_sharded(vstage(stages, state))  # [pp, mb, t, dim]
+        out_idx = s - (n_pp - 1)
+        if out_idx >= 0:
+            outs = outs.at[out_idx].set(y[-1])  # drain the last stage
+        # hop: stage i's output becomes stage i+1's input — on the pp-sharded
+        # axis this is the CollectivePermute the reference built from TCP
+        # socket writes (src/nn/nn-network.cpp:537-569)
+        state = jnp.roll(y, 1, axis=0)
 
-    layer_specs = jax.tree.map(lambda _: P("pp"), params.layers)
-    out = shard_map(
-        inner,
-        mesh=mesh,
-        in_specs=(layer_specs, P()),
-        out_specs=P(),
-        axis_names={"pp"},
-        check_vma=False,
-    )(params.layers, xmb)
-
-    x = out.reshape(b, t, -1)
+    x = outs.reshape(b, t, -1)
     y = rms_norm(x, params.rms_final, config.norm_epsilon)
     return matmul(y, params.wcls).astype(jnp.float32)

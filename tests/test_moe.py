@@ -116,7 +116,7 @@ def test_moe_ep_sharded_forward_parity(moe_model):
     positions = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
     ref, _ = llama_forward(config, params, tokens, positions, init_kv_cache(config, 1))
     sp_params = shard_params(params, mesh)
-    assert sp_params.layers.w1.sharding.spec == jax.sharding.PartitionSpec(None, "ep", None, "tp")
+    assert sp_params.layers.w1.sharding.spec == jax.sharding.PartitionSpec("pp", "ep", None, "tp")
     got, _ = llama_forward(config, sp_params, tokens, positions, init_kv_cache(config, 1))
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4)
 

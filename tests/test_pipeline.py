@@ -1,0 +1,99 @@
+"""Pipeline parallelism (parallel/pipeline.py): GPipe schedule over pp.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 — its paper
+explicitly contrasts TP with layer splitting), so the bar here is
+self-parity: the staged schedule must match the plain scanned forward
+exactly, forward and backward, alone and composed with dp/tp.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_multiusers_tpu.models import params_from_random
+from distributed_llama_multiusers_tpu.models.config import LlamaConfig
+from distributed_llama_multiusers_tpu.models.llama import llama_forward_train
+from distributed_llama_multiusers_tpu.parallel import MeshPlan, make_mesh
+from distributed_llama_multiusers_tpu.parallel.pipeline import pipeline_forward_train
+from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+
+CONFIG = LlamaConfig(
+    dim=64, hidden_dim=128, n_layers=4, n_heads=4, n_kv_heads=2,
+    vocab_size=96, seq_len=32,
+)
+
+
+def _tokens(b=4, t=8):
+    return jnp.asarray(np.random.default_rng(0).integers(0, 96, (b, t)), jnp.int32)
+
+
+def test_pipeline_pp2_logits_parity():
+    mesh = make_mesh(MeshPlan(pp=2))
+    params = shard_params(params_from_random(CONFIG, seed=0, dtype=jnp.float32), mesh)
+    tokens = _tokens()
+    got = pipeline_forward_train(CONFIG, params, tokens, mesh=mesh)
+    ref = llama_forward_train(CONFIG, params, tokens, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_composes_with_tp_dp():
+    """pp2 x tp2 x dp2 — per-stage compute stays tensor-parallel under GSPMD."""
+    mesh = make_mesh(MeshPlan(pp=2, tp=2, dp=2))
+    params = shard_params(params_from_random(CONFIG, seed=0, dtype=jnp.float32), mesh)
+    tokens = _tokens()
+    got = pipeline_forward_train(CONFIG, params, tokens, mesh=mesh)
+    ref = llama_forward_train(CONFIG, params, tokens, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_grad_matches_dense():
+    """The staged schedule transposes correctly: grads == plain-scan grads."""
+    mesh = make_mesh(MeshPlan(pp=2, tp=2, dp=2))
+    params = shard_params(params_from_random(CONFIG, seed=0, dtype=jnp.float32), mesh)
+    tokens = _tokens()
+
+    def loss(fwd):
+        def f(p):
+            logits = fwd(CONFIG, p, tokens, mesh=mesh)
+            return jnp.mean(jax.nn.logsumexp(logits, axis=-1))
+        return jax.jit(jax.value_and_grad(f))
+
+    val_pp, grads_pp = loss(pipeline_forward_train)(params)
+    val_ref, grads_ref = loss(llama_forward_train)(params)
+    assert abs(float(val_pp) - float(val_ref)) < 1e-6
+    for a, b in zip(jax.tree.leaves(grads_pp), jax.tree.leaves(grads_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+
+def test_pipeline_extra_microbatches():
+    """M > pp microbatches fill the bubble; schedule stays exact."""
+    mesh = make_mesh(MeshPlan(pp=2))
+    params = shard_params(params_from_random(CONFIG, seed=0, dtype=jnp.float32), mesh)
+    tokens = _tokens(b=8)
+    got = pipeline_forward_train(CONFIG, params, tokens, mesh=mesh, n_microbatches=4)
+    ref = llama_forward_train(CONFIG, params, tokens, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_pp1_falls_back():
+    mesh = make_mesh(MeshPlan(tp=2))
+    params = shard_params(params_from_random(CONFIG, seed=0, dtype=jnp.float32), mesh)
+    tokens = _tokens()
+    got = pipeline_forward_train(CONFIG, params, tokens, mesh=mesh)
+    ref = llama_forward_train(CONFIG, params, tokens, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0, rtol=0)
+
+
+def test_pipeline_validation_errors():
+    mesh = make_mesh(MeshPlan(pp=2))
+    params = shard_params(params_from_random(CONFIG, seed=0, dtype=jnp.float32), mesh)
+    with pytest.raises(ValueError, match="not divisible into"):
+        pipeline_forward_train(CONFIG, params, _tokens(b=3), mesh=mesh, n_microbatches=2)
+    bad = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=3, n_heads=4, n_kv_heads=2,
+        vocab_size=96, seq_len=32,
+    )
+    bad_params = params_from_random(bad, seed=0, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="not divisible by pp"):
+        pipeline_forward_train(bad, bad_params, _tokens(), mesh=mesh)
