@@ -59,15 +59,14 @@ def load_stack(args, n_lanes: int | None = None):
         validate_mesh_for_config(config, plan)
         mesh = make_mesh(plan)
         params = shard_params(params, mesh)
-        from ..ops.linear import set_pallas_enabled
-
-        # GSPMD cannot partition the Pallas kernel; sharded forwards take the
-        # XLA dequant path (shard_map wrapping is the planned upgrade)
-        set_pallas_enabled(False)
+        # the Pallas Q40 kernel stays enabled: q40_matmul_partitioned carries
+        # a GSPMD partitioning rule, so every shard runs dequant-in-matmul —
+        # the reference's every-node-runs-the-quantized-matmul property
+        # (src/nn/nn-cpu-ops.cpp:222-440)
         log(
             "⭕",
-            f"Mesh: dp={plan.dp} tp={plan.tp} sp={plan.sp} ep={plan.ep} "
-            f"over {plan.n_devices} devices",
+            f"Mesh: dp={plan.dp} pp={plan.pp} tp={plan.tp} sp={plan.sp} "
+            f"ep={plan.ep} over {plan.n_devices} devices",
         )
     log("💿", "Weights loaded")
 

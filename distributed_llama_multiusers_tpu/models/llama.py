@@ -112,17 +112,19 @@ def _moe_router_weights(y: jnp.ndarray, moe_gate: jnp.ndarray, n_active: int) ->
     return jnp.einsum("btk,btke->bte", w, onehot)
 
 
-def _moe_ffn(y, yq, lp, act_fn, n_active: int, maybe_qdq):
+def _moe_ffn(y, yq, lp, act_fn, n_active: int, maybe_qdq, ep_sharded: bool = False):
     """Gated-FFN mixture: every expert computes (dense dispatch — static
     shapes, no data-dependent gather; selection happens through the zero
     routing weights), outputs combined by router weight. Under an ep-sharded
     mesh the expert axis of the einsums partitions and XLA inserts the psum
     at the final reduction.
 
-    PackedQ40 expert stacks take a static per-expert loop ONLY when the
-    Pallas dequant-matmul is live (single-device TPU): on a mesh, slicing the
-    ep-sharded expert axis would all-gather every expert's weights onto every
-    shard, so there the stacked planes are dequantized in place (elementwise,
+    PackedQ40 expert stacks take a static per-expert loop when the Pallas
+    dequant-matmul is live and the expert axis is NOT mesh-sharded (the
+    per-expert 2D matmuls still partition over tp via
+    q40_matmul_partitioned): slicing an ep-sharded expert axis would
+    all-gather every expert's weights onto every shard, so with
+    ``ep_sharded`` the stacked planes are dequantized in place (elementwise,
     partitions over ep) and flow through the einsum path."""
     from ..ops.linear import pallas_kernel_active
     from ..quants.packed import PackedQ40, unpack_q40
@@ -130,7 +132,7 @@ def _moe_ffn(y, yq, lp, act_fn, n_active: int, maybe_qdq):
     rw = _moe_router_weights(y, lp.moe_gate, n_active)  # [B,T,E] f32
     w1, w2, w3 = lp.w1, lp.w2, lp.w3
     if isinstance(w1, PackedQ40):
-        if pallas_kernel_active():
+        if pallas_kernel_active() and not ep_sharded:
             out = None
             for e in range(w1.packed.shape[0]):
                 g = act_fn(matmul(yq, PackedQ40(w1.packed[e], w1.scales[e])))
@@ -231,7 +233,10 @@ def llama_forward(
         y = rms_norm(x, lp.rms_ffn, eps)
         yq = maybe_qdq(y)
         if h_cfg.n_experts > 0:
-            d = _moe_ffn(y, yq, lp, act_fn, h_cfg.n_active_experts, maybe_qdq)
+            d = _moe_ffn(
+                y, yq, lp, act_fn, h_cfg.n_active_experts, maybe_qdq,
+                ep_sharded=mesh is not None and mesh.shape.get("ep", 1) > 1,
+            )
         else:
             g = act_fn(matmul(yq, lp.w1))
             u = matmul(yq, lp.w3)
@@ -267,14 +272,15 @@ def llama_forward_train(
 
     x = params.embedding[tokens]
     layer_step = train_layer_step_fn(
-        config, params.rope_cos, params.rope_sin, mesh=mesh if use_sp else None
+        config, params.rope_cos, params.rope_sin, mesh=mesh if use_sp else None,
+        ep_sharded=mesh is not None and mesh.shape.get("ep", 1) > 1,
     )
     x, _ = jax.lax.scan(layer_step, x, params.layers)
     y = rms_norm(x, params.rms_final, eps)
     return matmul(y, params.wcls).astype(jnp.float32)
 
 
-def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None):
+def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None, ep_sharded=False):
     """The causal full-sequence transformer layer as a lax.scan step
     ``(x [B,T,dim], lp) -> (x, None)`` — shared by llama_forward_train and
     the pipeline-parallel schedule (parallel/pipeline.py). With ``mesh``,
@@ -313,7 +319,10 @@ def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None):
 
         y = rms_norm(x, lp.rms_ffn, eps)
         if config.n_experts > 0:
-            x = x + _moe_ffn(y, y, lp, act_fn, config.n_active_experts, lambda v: v)
+            x = x + _moe_ffn(
+                y, y, lp, act_fn, config.n_active_experts, lambda v: v,
+                ep_sharded=ep_sharded,
+            )
         else:
             x = x + matmul(act_fn(matmul(y, lp.w1)) * matmul(y, lp.w3), lp.w2)
         return x, None

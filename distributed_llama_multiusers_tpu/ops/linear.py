@@ -17,16 +17,24 @@ import jax.numpy as jnp
 
 from ..quants.packed import PackedQ40, q40_matmul_xla
 
-# Pallas has no GSPMD partitioning rule: on a multi-chip mesh the sharded
-# forward must take the XLA dequant path (which partitions cleanly) until the
-# kernel is wrapped in shard_map. runtime_setup flips this off when it builds
-# a >1-device mesh.
+# The kernel carries its own GSPMD partitioning rule
+# (ops/pallas_q40.q40_matmul_partitioned), so it stays on under meshes:
+# row-sliced shards run it locally, col-sliced shards psum the partials.
 _pallas_enabled = True
+
+# Test hook: route PackedQ40 matmuls through the partitioned Pallas path in
+# interpret mode even off-TPU, so CPU meshes exercise kernel + partitioning.
+_pallas_interpret = False
 
 
 def set_pallas_enabled(enabled: bool) -> None:
     global _pallas_enabled
     _pallas_enabled = enabled
+
+
+def set_pallas_interpret(enabled: bool) -> None:
+    global _pallas_interpret
+    _pallas_interpret = enabled
 
 
 @lru_cache(maxsize=1)
@@ -52,17 +60,15 @@ def _pallas_q40_matmul():
 
 def pallas_kernel_active() -> bool:
     """Whether PackedQ40 matmuls currently route to the Pallas kernel."""
-    return _pallas_enabled and _pallas_q40_matmul() is not None
+    return _pallas_enabled and (_pallas_interpret or _pallas_q40_matmul() is not None)
 
 
 def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """y = x @ w for dense [.., d_in, d_out] arrays or PackedQ40 weights."""
     if isinstance(w, PackedQ40):
-        kernel = _pallas_q40_matmul() if _pallas_enabled else None
-        if kernel is not None:
-            from .pallas_q40 import pallas_supports
+        if w.packed.ndim == 2 and pallas_kernel_active():
+            from .pallas_q40 import q40_matmul_partitioned
 
-            if pallas_supports(w):
-                return kernel(x, w)
+            return q40_matmul_partitioned(x, w, interpret=_pallas_interpret)
         return q40_matmul_xla(x, w)
     return x @ w

@@ -178,3 +178,109 @@ def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False) -> 
     )(xf, w.packed, scale_bits)
 
     return out[:m].reshape(*lead, d_out)
+
+
+# ---------------------------------------------------------------------------
+# GSPMD integration: a partitioning rule for the kernel.
+#
+# Pallas calls are opaque to the SPMD partitioner, so without this a sharded
+# forward would have to fall back to XLA dequant (round 1 disabled the kernel
+# under any mesh). custom_partitioning teaches XLA to treat the quantized
+# matmul like a dot: row-sliced weights (d_out sharded, reference
+# sliceRowMatmul src/nn/nn-core.cpp:207-217) run the kernel per shard with a
+# sharded output; col-sliced weights (d_in sharded, sliceColMatmul
+# :219-230) run it per shard and psum the partial sums — the collective the
+# reference realizes as its quantized TCP all-gather + merge_add.
+# ---------------------------------------------------------------------------
+
+from jax.experimental.custom_partitioning import custom_partitioning  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def _q40_mm_impl(x, packed, scales, interpret):
+    """Single-shard implementation: Pallas when the (local) shapes fit,
+    XLA dequant otherwise. Runs unmodified on 1 device; partitioned, each
+    shard re-evaluates `pallas_supports` on its local shapes."""
+    from ..quants.packed import q40_matmul_xla
+
+    w = PackedQ40(packed=packed, scales=scales)
+    if pallas_supports(w):
+        return q40_matmul_pallas(x, w, interpret=interpret)
+    return q40_matmul_xla(x, w)
+
+
+def _pad_spec(sharding, rank):
+    spec = tuple(sharding.spec) if sharding.spec is not None else ()
+    return spec + (None,) * (rank - len(spec))
+
+
+def _spec_axes(entry):
+    if entry is None:
+        return set()
+    return set(entry) if isinstance(entry, tuple) else {entry}
+
+
+def _plan(mesh, arg_shapes):
+    """(x_spec, packed_spec, scales_spec, out_spec, k_spec) — the canonical
+    sharding layout nearest to what the operands arrived with."""
+    x_s, p_s, _ = (a.sharding for a in arg_shapes)
+    x_rank = len(arg_shapes[0].shape)
+    x_spec = _pad_spec(x_s, x_rank)
+    p_spec = _pad_spec(p_s, 2)
+
+    k_spec = p_spec[0] if p_spec[0] is not None else x_spec[-1]
+    n_spec = p_spec[1]
+    if _spec_axes(k_spec) & _spec_axes(n_spec):
+        k_spec = None  # conflicting proposal: replicate the contraction
+    used = _spec_axes(k_spec) | _spec_axes(n_spec)
+    lead = tuple(s if not (_spec_axes(s) & used) else None for s in x_spec[:-1])
+
+    ns = lambda *spec: NamedSharding(mesh, P(*spec))
+    return (
+        ns(*lead, k_spec),
+        ns(k_spec, n_spec),
+        ns(k_spec, n_spec),
+        ns(*lead, n_spec),
+        k_spec,
+    )
+
+
+def _q40_mm_infer_sharding(interpret, mesh, arg_shapes, result_shape):
+    del interpret, result_shape
+    return _plan(mesh, arg_shapes)[3]
+
+
+def _q40_mm_partition(interpret, mesh, arg_shapes, result_shape):
+    del result_shape
+    x_sh, p_sh, s_sh, out_sh, k_spec = _plan(mesh, arg_shapes)
+
+    def lower(x, packed, scales):
+        y = _q40_mm_impl(x, packed, scales, interpret)
+        if k_spec is not None:
+            y = jax.lax.psum(y, k_spec)
+        return y
+
+    return mesh, lower, out_sh, (x_sh, p_sh, s_sh)
+
+
+_q40_mm = custom_partitioning(_q40_mm_impl, static_argnums=(3,))
+_q40_mm.def_partition(
+    partition=_q40_mm_partition,
+    infer_sharding_from_operands=_q40_mm_infer_sharding,
+    # x [..., (b*32)], packed [(b*16), n], scales [b, n] -> [..., n]:
+    # b = quant blocks of the contraction (reduction); the intra-block
+    # subfactors must never be split across devices
+    sharding_rule="... (b t), (b s) n, b n -> ... n",
+    reduction_factors=("b",),
+    need_replication_factors=("t", "s"),
+    t=32,
+    s=16,
+)
+
+
+def q40_matmul_partitioned(x: jnp.ndarray, w: PackedQ40, interpret: bool = False) -> jnp.ndarray:
+    """y = x @ dequant(w), partitionable under GSPMD meshes (TP/EP serving
+    keeps dequant-in-matmul, closing round 1's 'Pallas disabled under any
+    mesh' gap). Single device: identical to q40_matmul_pallas with XLA
+    fallback for unsupported shapes."""
+    return _q40_mm(x, w.packed, w.scales, interpret)

@@ -86,7 +86,10 @@ def pipeline_forward_train(
     xmb = jax.lax.with_sharding_constraint(
         x.reshape(m, mb, t, x.shape[-1]), NamedSharding(mesh, P(None, "dp"))
     )
-    layer_step = train_layer_step_fn(config, params.rope_cos, params.rope_sin)
+    layer_step = train_layer_step_fn(
+        config, params.rope_cos, params.rope_sin,
+        ep_sharded=mesh.shape.get("ep", 1) > 1,
+    )
 
     def stage_fn(layers_local, xin):
         return jax.lax.scan(layer_step, xin, layers_local)[0]
