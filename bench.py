@@ -1,11 +1,20 @@
 """Benchmark: single-stream decode throughput of the flagship model on TPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Metric: batch=1 greedy decode tokens/sec for a Llama-3.2-1B-shaped model with
 Q40 weights at rest in HBM (int4+f16 scales, dequant-in-matmul Pallas kernel
 — the same weight format the reference runs, src/nn/nn-quants.hpp:64-67) and
-a 2048-token KV cache.
+a 2048-token KV cache. Extras: effective weight-read bandwidth, MFU, and
+kernel ablations (packed Q40 via XLA dequant, dense bf16) so the Pallas
+kernel's contribution is in the artifact, not a commit message.
+
+Resilience (round 1 shipped rc=1 with zero perf evidence when the axon
+backend failed at init): the top-level process is a thin watchdog that runs
+the real bench in a child with a timeout, retries TPU init failures, falls
+back to a small CPU run when the TPU never comes up, and — if everything
+fails — still emits a diagnostic JSON line and exits 0 so the failure mode
+is recorded in BENCH_r{N}.json instead of a traceback.
 
 Timing is honest under async dispatch: the whole generation loop runs
 device-side (lax.scan with the sampled token fed back), completion is forced
@@ -14,15 +23,16 @@ between a short and a long run — constant dispatch/transfer overheads cancel.
 
 vs_baseline: ratio against the reference's best published single-device
 number — Llama 2 7B on 1x RPi 4B at 1312.50 ms/token = 0.762 tok/s
-(report.pdf Fig. 3, BASELINE.md). Caveat: model sizes differ (1B here vs 7B
-there); the 7B/8-node figure (588 ms/token, 1.70 tok/s) is the distributed
-headline this framework targets at scale.
+(report.pdf Fig. 3, BASELINE.md). Model sizes differ (1B vs 7B); the
+per-chip north star (BASELINE.md: Llama-3.1-8B Q40, >=200 tok/s/chip) is
+benched by the optional BENCH_8B=1 path on real hardware.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 from functools import partial
@@ -30,29 +40,45 @@ from functools import partial
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REFERENCE_SINGLE_DEVICE_TOK_S = 1000.0 / 1312.50  # report.pdf Fig. 3
+METRIC = "llama32_1b_q40_decode_tok_s"
+
+# bf16 peak TFLOP/s and HBM GB/s per chip by device kind (public specs)
+_CHIP_SPECS = {
+    "TPU v4": (275e12, 1228e9),
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v5e": (197e12, 819e9),
+    "TPU v5": (459e12, 2765e9),
+    "TPU v5p": (459e12, 2765e9),
+    "TPU v6 lite": (918e12, 1640e9),
+    "TPU v6e": (918e12, 1640e9),
+}
 
 
-def main() -> None:
+def _chip_spec(device_kind: str):
+    for k, v in _CHIP_SPECS.items():
+        if device_kind.lower().startswith(k.lower()):
+            return v
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# Child: the actual benchmark (runs under the watchdog).
+# ---------------------------------------------------------------------------
+
+
+def _tree_device_bytes(tree) -> int:
+    import jax
+
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def _bench_decode(config, params, n_short, n_long, reps=3, tag=""):
+    """Marginal decode tok/s for one param set."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from __graft_entry__ import _flagship_config
-    from distributed_llama_multiusers_tpu.models import (
-        init_kv_cache,
-        llama_forward,
-        params_from_random,
-    )
-    from distributed_llama_multiusers_tpu.models.loader import quantize_params
-
-    small = os.environ.get("GRAFT_SMALL") == "1"
-    config = _flagship_config(small=small)
-    # generate + quantize host-side; upload only the packed ~4.5-bit planes
-    host = quantize_params(
-        params_from_random(config, seed=0, dtype=jnp.bfloat16, to_device=False),
-        to_device=False,
-    )
-    params = jax.tree.map(jax.device_put, host)
+    from distributed_llama_multiusers_tpu.models import init_kv_cache, llama_forward
 
     def make_generate(n_steps):
         @partial(jax.jit, donate_argnums=(1,))
@@ -66,10 +92,7 @@ def main() -> None:
                 return (nxt, pos + 1, cache), nxt
 
             (_, _, cache), toks = jax.lax.scan(
-                body,
-                (first_token, start_pos, cache),
-                None,
-                length=n_steps,
+                body, (first_token, start_pos, cache), None, length=n_steps
             )
             return toks, cache
 
@@ -78,7 +101,7 @@ def main() -> None:
     first = jnp.zeros((1,), jnp.int32)
     pos0 = jnp.zeros((1,), jnp.int32)
 
-    def timed(n_steps, reps=3):
+    def timed(n_steps):
         gen = make_generate(n_steps)
         best = float("inf")
         for _ in range(reps + 1):  # first rep is compile+warmup
@@ -90,27 +113,202 @@ def main() -> None:
             best = min(best, dt)
         return best
 
-    n_short, n_long = (4, 16) if small else (16, 128)
     t_short = timed(n_short)
     t_long = timed(n_long)
+    print(f"[bench] {tag}: short({n_short})={t_short:.3f}s long({n_long})={t_long:.3f}s",
+          file=sys.stderr, flush=True)
     if t_long - t_short > 0.1 * t_long:
-        tok_s = (n_long - n_short) / (t_long - t_short)
-    else:
-        # marginal signal below dispatch-overhead noise (tiny models / fast
-        # chips): report the conservative whole-run rate instead
-        tok_s = n_long / t_long
+        return (n_long - n_short) / (t_long - t_short)
+    # marginal signal below dispatch-overhead noise: conservative whole-run rate
+    return n_long / t_long
+
+
+def child_main() -> None:
+    # CPU runs must strip the TPU PJRT plugin BEFORE backend discovery: this
+    # box's sitecustomize registers one whose init dials a network tunnel,
+    # and it blocks discovery even under JAX_PLATFORMS=cpu (see
+    # utils/testing.force_cpu_mesh — the same reason round 1's bench hung)
+    if os.environ.get("BENCH_FORCE_CPU") == "1" or os.environ.get("JAX_PLATFORMS") == "cpu":
+        from distributed_llama_multiusers_tpu.utils.testing import force_cpu_mesh
+
+        force_cpu_mesh(n_devices=1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship_config
+    from distributed_llama_multiusers_tpu.models import params_from_random
+    from distributed_llama_multiusers_tpu.models.loader import quantize_params
+    from distributed_llama_multiusers_tpu.ops import linear
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    device_kind = getattr(dev, "device_kind", platform)
+    print(f"[bench] backend up: {platform} ({device_kind})", file=sys.stderr, flush=True)
+
+    small = os.environ.get("GRAFT_SMALL") == "1" or platform != "tpu"
+    config = _flagship_config(small=small)
+    n_short, n_long = (4, 16) if small else (16, 128)
+
+    # generate + quantize host-side; upload only the packed ~4.5-bit planes
+    host_dense = params_from_random(config, seed=0, dtype=jnp.bfloat16, to_device=False)
+    host_q = quantize_params(host_dense, to_device=False)
+    params_q = jax.tree.map(jax.device_put, host_q)
+
+    tok_s = _bench_decode(config, params_q, n_short, n_long, tag="packed+pallas")
+
+    weight_bytes = _tree_device_bytes(params_q)
+    peak_flops, peak_bw = _chip_spec(str(device_kind))
+    n_param_flops = 2 * sum(
+        x.size for x in jax.tree.leaves(host_dense)
+    )  # 2*params matmul FLOPs/token (upper bound incl. embedding)
+
+    result = {
+        "metric": METRIC,
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / REFERENCE_SINGLE_DEVICE_TOK_S, 2),
+        "platform": platform,
+        "device_kind": str(device_kind),
+        "weight_read_gb_s": round(weight_bytes * tok_s / 1e9, 1),
+        "mfu": round(n_param_flops * tok_s / peak_flops, 4) if peak_flops else None,
+        "hbm_util": round(weight_bytes * tok_s / peak_bw, 3) if peak_bw else None,
+        "baseline_note": "reference Llama-2-7B on 1x RPi 4B, 0.762 tok/s (report.pdf Fig.3)",
+    }
+    # bank the primary metric NOW: the watchdog parses the LAST stdout JSON
+    # line, so if the ablations/8B extras below blow the child's time budget
+    # or crash, this line still carries the measurement (round 1 failure mode)
+    print(json.dumps(result), flush=True)
+
+    # --- ablations: what the Pallas kernel buys over XLA dequant / dense ---
+    if os.environ.get("BENCH_ABLATIONS", "1") == "1":
+        linear.set_pallas_enabled(False)
+        try:
+            result["ablation_xla_dequant_tok_s"] = round(
+                _bench_decode(config, params_q, n_short, n_long, tag="packed+xla-dequant"), 2
+            )
+        finally:
+            linear.set_pallas_enabled(True)
+        del params_q
+        params_d = jax.tree.map(jax.device_put, host_dense)
+        result["ablation_dense_bf16_tok_s"] = round(
+            _bench_decode(config, params_d, n_short, n_long, tag="dense-bf16"), 2
+        )
+        del params_d
+
+    # --- optional: the BASELINE north-star model (Llama-3.1-8B geometry) ---
+    if os.environ.get("BENCH_8B") == "1" and platform == "tpu":
+        from distributed_llama_multiusers_tpu.models.config import LlamaConfig
+
+        cfg8 = LlamaConfig(
+            dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
+            vocab_size=128256, seq_len=2048, rope_theta=500000.0,
+            rope_scaling_factor=8.0, rope_scaling_low_freq_factor=1.0,
+            rope_scaling_high_freq_factor=4.0, rope_scaling_orig_max_seq_len=8192,
+        )
+        print("[bench] generating 8B random Q40 params (host)...", file=sys.stderr, flush=True)
+        host8 = quantize_params(
+            params_from_random(cfg8, seed=0, dtype=jnp.bfloat16, to_device=False),
+            to_device=False,
+        )
+        params8 = jax.tree.map(jax.device_put, host8)
+        del host8
+        tok8 = _bench_decode(cfg8, params8, 8, 64, reps=2, tag="8b packed+pallas")
+        result["llama31_8b_q40_decode_tok_s"] = round(tok8, 2)
+        result["llama31_8b_northstar_frac"] = round(tok8 / 200.0, 3)
+
+    print(json.dumps(result), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent: watchdog. Retries, CPU fallback, diagnostic JSON on total failure.
+# ---------------------------------------------------------------------------
+
+
+def _run_child(env_extra: dict, timeout_s: float):
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env.update(env_extra)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        # a timed-out child may still have banked its primary-metric line
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        for line in reversed(out.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    parsed = json.loads(line)
+                    parsed["timed_out_in_extras"] = True
+                    return parsed, None
+                except json.JSONDecodeError:
+                    continue
+        tail = e.stderr or ""
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        return None, f"timeout after {timeout_s:.0f}s; stderr tail: {tail[-300:]}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, (
+        f"rc={proc.returncode}; stderr tail: {proc.stderr[-400:] if proc.stderr else ''}"
+    )
+
+
+def main() -> None:
+    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE", "2700"))
+    errors = []
+
+    # TPU attempts (the axon backend is flaky at init: round 1 died there)
+    for attempt in range(2):
+        budget = min(1500.0, deadline - time.monotonic())
+        if budget < 120:
+            break
+        result, err = _run_child({}, budget)
+        if result is not None:
+            result["attempts"] = attempt + 1
+            print(json.dumps(result))
+            return
+        errors.append(f"tpu[{attempt}]: {err}")
+        print(f"[bench-watchdog] {errors[-1]}", file=sys.stderr, flush=True)
+        time.sleep(20)
+
+    # CPU fallback: degraded evidence beats no evidence
+    budget = max(120.0, deadline - time.monotonic())
+    result, err = _run_child(
+        {"BENCH_FORCE_CPU": "1", "GRAFT_SMALL": "1", "BENCH_ABLATIONS": "0"}, budget
+    )
+    if result is not None:
+        result["platform"] = "cpu-fallback"
+        result["tpu_errors"] = errors
+        print(json.dumps(result))
+        return
+    errors.append(f"cpu: {err}")
 
     print(
         json.dumps(
             {
-                "metric": "llama32_1b_q40_decode_tok_s",
-                "value": round(tok_s, 2),
+                "metric": METRIC,
+                "value": None,
                 "unit": "tok/s",
-                "vs_baseline": round(tok_s / REFERENCE_SINGLE_DEVICE_TOK_S, 2),
+                "vs_baseline": None,
+                "error": "; ".join(errors)[-1200:],
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        child_main()
+    else:
+        main()
