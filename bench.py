@@ -225,6 +225,23 @@ def child_main() -> None:
 # ---------------------------------------------------------------------------
 
 
+def _text(x) -> str:
+    if isinstance(x, bytes):
+        return x.decode(errors="replace")
+    return x or ""
+
+
+def _last_json_line(out: str):
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
 def _run_child(env_extra: dict, timeout_s: float):
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
@@ -236,32 +253,19 @@ def _run_child(env_extra: dict, timeout_s: float):
         )
     except subprocess.TimeoutExpired as e:
         # a timed-out child may still have banked its primary-metric line
-        out = e.stdout or ""
-        if isinstance(out, bytes):
-            out = out.decode(errors="replace")
-        for line in reversed(out.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    parsed = json.loads(line)
-                    parsed["timed_out_in_extras"] = True
-                    return parsed, None
-                except json.JSONDecodeError:
-                    continue
-        tail = e.stderr or ""
-        if isinstance(tail, bytes):
-            tail = tail.decode(errors="replace")
-        return None, f"timeout after {timeout_s:.0f}s; stderr tail: {tail[-300:]}"
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except json.JSONDecodeError:
-                continue
-    return None, (
-        f"rc={proc.returncode}; stderr tail: {proc.stderr[-400:] if proc.stderr else ''}"
-    )
+        parsed = _last_json_line(_text(e.stdout))
+        if parsed is not None:
+            parsed["timed_out_in_extras"] = True
+            return parsed, None
+        return None, f"timeout after {timeout_s:.0f}s; stderr tail: {_text(e.stderr)[-300:]}"
+    parsed = _last_json_line(proc.stdout)
+    if parsed is not None:
+        if proc.returncode != 0:
+            # extras crashed after the primary line was banked: keep the
+            # evidence AND the failure, instead of an unmarked success
+            parsed["crashed_in_extras"] = _text(proc.stderr)[-300:]
+        return parsed, None
+    return None, f"rc={proc.returncode}; stderr tail: {_text(proc.stderr)[-400:]}"
 
 
 def main() -> None:
@@ -280,7 +284,8 @@ def main() -> None:
             return
         errors.append(f"tpu[{attempt}]: {err}")
         print(f"[bench-watchdog] {errors[-1]}", file=sys.stderr, flush=True)
-        time.sleep(20)
+        if attempt < 1:  # no point sleeping after the final attempt
+            time.sleep(20)
 
     # CPU fallback: degraded evidence beats no evidence
     budget = max(120.0, deadline - time.monotonic())

@@ -1,0 +1,161 @@
+"""Token-parity gate against the REAL C++ reference binary.
+
+Every other parity test compares the JAX path to this repo's own numpy
+oracle; this one closes the loop against the actual reference
+(src/dllama.cpp:36-113): build `dllama` from the reference sources, write a
+tiny synthetic Q40 .m/.t pair with THIS repo's writers, run both engines
+greedy on the same prompt, and assert the predicted tokens are identical —
+the BASELINE.md "output token-identical to the 1-node CPU reference" bar.
+
+Heavy (builds C++, and the reference's busy-spinning request-queue thread
+makes it ~30 s/token on a single-core box — fork defect, app.cpp:314-402),
+so it runs only when DLLAMA_REF_PARITY=1. A recorded transcript lives in
+examples/reference_parity_transcript.md.
+
+    DLLAMA_REF_PARITY=1 DLLAMA_REF_SRC=/root/reference \
+        python -m pytest tests/test_reference_parity.py -v
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REF_SRC = os.environ.get("DLLAMA_REF_SRC", "/root/reference")
+N_PREDICT = 6  # predicted tokens to compare (~30 s each on 1 core, worst case)
+REF_DEADLINE_S = 600.0  # wall clock for the reference to produce them
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("DLLAMA_REF_PARITY") != "1"
+    or not os.path.isdir(REF_SRC)
+    or shutil.which("g++") is None,
+    reason="reference parity gate runs only with DLLAMA_REF_PARITY=1, "
+    "the reference sources, and g++",
+)
+
+_PRED_RE = re.compile(r"^🔶 Pred.*kB Recv\s*\d+ kB \| (.*)$")
+
+
+def _build_reference(tmp: str) -> str:
+    """Build the reference dllama CPU-only (its Makefile, -Werror relaxed:
+    the vendored llamafile sgemm trips newer-gcc warnings)."""
+    build = os.path.join(tmp, "refbuild")
+    shutil.copytree(REF_SRC, build)
+    mk = os.path.join(build, "Makefile")
+    with open(mk) as f:
+        text = f.read()
+    text = text.replace(
+        "CXXFLAGS = -std=c++11 -Werror -Wformat -Werror=format-security",
+        "CXXFLAGS = -std=c++11 -Wformat",
+    )
+    with open(mk, "w") as f:
+        f.write(text)
+    # the reference tree ships prebuilt (foreign-ABI) .o artifacts that make
+    # considers up-to-date; they must go before the real build
+    for f_ in os.listdir(build):
+        if f_.endswith(".o") or f_ == "dllama":
+            os.unlink(os.path.join(build, f_))
+    subprocess.run(
+        ["make", "dllama"], cwd=build, check=True, capture_output=True, timeout=600
+    )
+    return os.path.join(build, "dllama")
+
+
+def _run_reference_greedy(binary: str, model: str, tok: str, prompt: str) -> list[str]:
+    """Stream the reference CLI and collect predicted pieces. The process is
+    killed once enough tokens arrive: its inference_loop thread never exits
+    (fork defect (d), app.cpp:303-317), so a clean exit never comes."""
+    proc = subprocess.Popen(
+        [
+            binary, "inference", "--model", model, "--tokenizer", tok,
+            "--prompt", prompt, "--steps", "32", "--temperature", "0.0",
+            "--buffer-float-type", "q80", "--nthreads", "1",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # the process never exits on its own, so the read loop needs its own
+    # deadline: a watchdog timer kills it and unblocks the blocking read
+    watchdog = threading.Timer(REF_DEADLINE_S, proc.kill)
+    watchdog.start()
+    pieces: list[str] = []
+    try:
+        for line in proc.stdout:
+            m = _PRED_RE.match(line.rstrip("\n"))
+            if m:
+                pieces.append(m.group(1))
+                if len(pieces) >= N_PREDICT:
+                    break
+    finally:
+        watchdog.cancel()
+        proc.kill()
+        proc.wait()
+    return pieces
+
+
+def _run_repo_greedy(model: str, tok: str, prompt: str) -> list[str]:
+    """The repo engine, greedy, with the reference's Q80 activation casts
+    emulated (--buffer-float-type q80 semantics)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.models import init_kv_cache, llama_forward
+    from distributed_llama_multiusers_tpu.models.loader import load_params_from_m
+    from distributed_llama_multiusers_tpu.tokenizer import Tokenizer
+
+    h = load_model_header(model)
+    config, params = load_params_from_m(model, h, dtype=jnp.float32)
+    t = Tokenizer(tok)
+    ids = t.encode(prompt, add_bos=True)
+
+    cache = init_kv_cache(config, 1)
+    logits = None
+    for pos, token in enumerate(ids):
+        logits, cache = llama_forward(
+            config, params,
+            jnp.asarray([[token]], jnp.int32), jnp.asarray([[pos]], jnp.int32),
+            cache, emulate_q80_activations=True,
+        )
+    pieces = []
+    pos = len(ids)
+    cur = int(logits[0, 0].argmax())
+    for _ in range(N_PREDICT):
+        pieces.append(t.vocab[cur].decode("utf-8", errors="replace"))
+        logits, cache = llama_forward(
+            config, params,
+            jnp.asarray([[cur]], jnp.int32), jnp.asarray([[pos]], jnp.int32),
+            cache, emulate_q80_activations=True,
+        )
+        pos += 1
+        cur = int(logits[0, 0].argmax())
+    return pieces
+
+
+def test_greedy_tokens_match_reference_binary(tmp_path):
+    from distributed_llama_multiusers_tpu.formats.synthetic import (
+        tiny_header,
+        write_synthetic_model,
+        write_synthetic_tokenizer,
+    )
+
+    tmp = str(tmp_path)
+    model = os.path.join(tmp, "m.m")
+    tok = os.path.join(tmp, "t.t")
+    header = tiny_header()
+    write_synthetic_model(model, header, seed=5)
+    write_synthetic_tokenizer(tok, vocab_size=header.vocab_size)
+
+    binary = _build_reference(tmp)
+    prompt = "hello world"
+    ref_pieces = _run_reference_greedy(binary, model, tok, prompt)
+    assert len(ref_pieces) == N_PREDICT, f"reference produced {ref_pieces}"
+    print(f"reference: {ref_pieces}", file=sys.stderr)
+
+    repo_pieces = _run_repo_greedy(model, tok, prompt)
+    print(f"repo:      {repo_pieces}", file=sys.stderr)
+    assert repo_pieces == ref_pieces
