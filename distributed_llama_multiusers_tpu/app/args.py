@@ -52,6 +52,13 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--chat-template", default=None, choices=[None, "llama2", "llama3", "deepSeek3"])
     p.add_argument("--workers", nargs="*", default=None,
                    help="TPU: device count or mesh spec (dp2,tp4); reference compat")
+    # multi-host pod bootstrap (reference: worker serve() + root connect,
+    # src/app.cpp:405-464 -> jax.distributed). Run the SAME command on every
+    # host with its own --process-id; workers use mode `worker`.
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 for jax.distributed multi-host")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--port", type=int, default=9990)
     p.add_argument("--host", default="0.0.0.0")
     # accepted for reference CLI compatibility; no-ops on TPU:

@@ -5,11 +5,12 @@
   Prediction readout).
 - chat: interactive chat with template rendering and streamed,
   stop-string-gated output (src/dllama.cpp:130-214).
-- worker: in the reference, a TCP node that receives its program from the
-  root (src/app.cpp:405-464). Under single-program SPMD there is no worker
-  binary — additional chips join via the mesh (--workers N). For multi-host
-  pods, each host runs the same program with jax.distributed; this mode
-  prints the equivalent invocation and exits.
+- worker: joins a jax.distributed pod (--coordinator/--num-processes/
+  --process-id) and replays root-broadcast engine calls until the root
+  sends stop — the SPMD analogue of the reference's TCP worker that
+  receives its program and control packets from the root
+  (src/app.cpp:405-464). Without coordinator flags it prints mesh guidance
+  and exits (single-host chips join via --workers N instead).
 """
 
 from __future__ import annotations
@@ -40,8 +41,10 @@ def run_inference(args) -> None:
     tokenizer.reset_decoder()
     out_pieces = []
     pred_times = []
-    toks = np.zeros(1, np.int32)
-    poss = np.zeros(1, np.int32)
+    # idle lanes beyond 0 are harmless (multi-host roots run max_lanes lanes
+    # so every process compiles identical decode shapes)
+    toks = np.zeros(engine.n_lanes, np.int32)
+    poss = np.zeros(engine.n_lanes, np.int32)
     for _ in range(args.steps):
         piece = tokenizer.decode(cur)
         if piece:
@@ -64,6 +67,8 @@ def run_inference(args) -> None:
         total = sum(pred_times)
         log("⏱", f"Evaluation: {eval_s * 1000:.2f} ms ({len(tokens) / eval_s:.2f} tok/s)")
         log("⏱", f"Prediction: {total * 1000:.2f} ms ({len(pred_times) / total:.2f} tok/s)")
+    if hasattr(engine, "stop_workers"):
+        engine.stop_workers()
 
 
 def run_chat(args) -> None:
@@ -80,6 +85,8 @@ def run_chat(args) -> None:
             user = input("\n> ")
         except EOFError:
             print()
+            if hasattr(engine, "stop_workers"):
+                engine.stop_workers()
             return
         items = []
         if first and args.prompt:
@@ -97,8 +104,8 @@ def run_chat(args) -> None:
 
         detector = EosDetector(tokenizer.eos_token_ids, stops.stops, 2, 2)
         decoder = tokenizer.make_stream_decoder()
-        toks = np.zeros(1, np.int32)
-        poss = np.zeros(1, np.int32)
+        toks = np.zeros(engine.n_lanes, np.int32)
+        poss = np.zeros(engine.n_lanes, np.int32)
         while pos < config.seq_len:
             piece = decoder.decode(cur)
             result = detector.append(cur, piece)
@@ -121,12 +128,30 @@ def run_chat(args) -> None:
 
 
 def run_worker(args) -> None:
-    import jax
+    """Join the pod and replay root-broadcast engine calls until the root
+    sends stop (reference: runWorkerApp, src/app.cpp:405-464).
 
-    n = len(jax.devices())
-    log("⭕", "TPU runs single-program SPMD: no separate worker process is needed.")
-    log("⭕", f"This host sees {n} device(s); shard with: dllama inference --workers {n} ...")
-    log("⭕", "Multi-host pods: run the same command on every host (jax.distributed auto-init).")
+    Launch (2 hosts):
+      host0: dllama inference --coordinator host0:1234 --num-processes 2 \
+                 --process-id 0 --workers tp8 --model m.m --tokenizer t.t ...
+      host1: dllama worker    --coordinator host0:1234 --num-processes 2 \
+                 --process-id 1 --workers tp8 --model m.m --tokenizer t.t
+    Both hosts load the same model file; --workers describes the GLOBAL mesh.
+    """
+    import os
+
+    from ..parallel.multihost import worker_loop
+
+    if not (args.coordinator or os.environ.get("DLLAMA_COORDINATOR")):
+        log("⭕", "Single process: no pod to join (pass --coordinator/--num-processes/--process-id).")
+        log("⭕", "Single-host chips need no worker: shard with dllama inference --workers N ...")
+        return
+    config, params, tokenizer, engine = load_stack(args)
+    plane = getattr(engine, "control_plane", None)
+    assert plane is not None, "coordinator flags set but pod join failed"
+    log("⭕", "Worker ready; replaying root engine calls")
+    worker_loop(engine, plane)
+    log("⭕", "Root sent stop; worker exiting")
 
 
 def main(argv=None) -> None:

@@ -24,7 +24,17 @@ def log(emoji: str, msg: str) -> None:
 
 
 def load_stack(args, n_lanes: int | None = None):
-    """Returns (config, params, tokenizer, engine)."""
+    """Returns (config, params, tokenizer, engine).
+
+    Multi-host (--coordinator): joins the pod before touching the backend;
+    on process 0 the engine comes back wrapped in RootControlEngine (every
+    call is broadcast to the workers first), and on workers the raw engine
+    carries `.control_plane` for `worker_loop`. Each host loads the model
+    file itself — under SPMD there is no root-ships-weights protocol
+    (reference: src/nn/nn-network.cpp:824-901)."""
+    from ..parallel.multihost import maybe_initialize_distributed
+
+    n_proc = maybe_initialize_distributed(args)
     if not args.model or not args.tokenizer:
         print("error: --model and --tokenizer are required", file=sys.stderr)
         raise SystemExit(2)
@@ -75,14 +85,34 @@ def load_stack(args, n_lanes: int | None = None):
     emulate_q80 = args.buffer_float_type == FloatType.Q80
     if emulate_q80:
         log("🔶", "Q80 activation-cast emulation enabled (--buffer-float-type q80)")
+    if n_proc > 1 and mesh is None:
+        print(
+            "error: multi-host runs need a --workers mesh spec spanning the "
+            "global device set",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     engine = InferenceEngine(
         config,
         params,
-        n_lanes=n_lanes or args.max_lanes,
+        # every process must compile identical programs: lane count comes
+        # from --max-lanes on all hosts (n_lanes overrides are single-host)
+        n_lanes=(n_lanes if n_proc == 1 else None) or args.max_lanes,
         cache_dtype=jnp.float32,
         emulate_q80_activations=emulate_q80,
         mesh=mesh,
+        replicate_outputs=n_proc > 1,
     )
+    if n_proc > 1:
+        from ..parallel.multihost import ControlPlane, RootControlEngine
+
+        plane = ControlPlane(engine.n_lanes, chunk=engine.prefill_buckets[-1])
+        if jax.process_index() == 0:
+            log("⭕", f"Multi-host root: {n_proc} processes, control plane up")
+            engine = RootControlEngine(engine, plane)
+        else:
+            log("⭕", f"Multi-host worker {jax.process_index()}/{n_proc}")
+            engine.control_plane = plane
     return config, params, tokenizer, engine
 
 

@@ -1,0 +1,161 @@
+"""Multi-host serving: jax.distributed bootstrap + a root->worker control
+plane over device collectives.
+
+Reference mapping (src/app.cpp):
+- cluster bootstrap (worker `serve()` + root connects and ships configs,
+  src/app.cpp:405-464, src/nn/nn-network.cpp:264-348) ->
+  ``jax.distributed.initialize``: every host runs the SAME program
+  (multi-controller SPMD) and chips join one global mesh; there is no
+  config/weight wire protocol because each host loads the model file and
+  ``shard_params`` places its addressable shards.
+- ``LlmControlPacket{position,batchSize}`` written to all workers before
+  every forward (src/app.cpp:198-209, `writeAll`) -> ``ControlPlane``:
+  a fixed-size int32 packet broadcast root->workers per engine call
+  (jax.experimental.multihost_utils.broadcast_one_to_all), carrying the op
+  (prefill/decode/stop) and its host-side arguments. batchSize=0 as the
+  stop signal (src/app.cpp:204-209) maps to OP_STOP.
+- worker mode's control-packet poll loop (src/app.cpp:218-231) ->
+  ``worker_loop``: recv packet, replay the identical engine call so every
+  process dispatches the same XLA program in lockstep.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+OP_STOP = 0
+OP_PREFILL = 1
+OP_DECODE = 2
+
+
+def maybe_initialize_distributed(args=None) -> int:
+    """Join a multi-host pod when coordinator flags/env are present; returns
+    the process count (1 when not distributed). Must run before the backend
+    initializes. Flags: --coordinator host:port --num-processes N
+    --process-id I, or env DLLAMA_COORDINATOR / DLLAMA_NUM_PROCESSES /
+    DLLAMA_PROCESS_ID."""
+    coord = getattr(args, "coordinator", None) or os.environ.get("DLLAMA_COORDINATOR")
+    if not coord:
+        return 1
+    n = int(
+        getattr(args, "num_processes", None)
+        or os.environ.get("DLLAMA_NUM_PROCESSES", "0")
+    )
+    pid_attr = getattr(args, "process_id", None)
+    pid = int(
+        pid_attr if pid_attr is not None else os.environ.get("DLLAMA_PROCESS_ID", "-1")
+    )
+    if n <= 0 or pid < 0:
+        raise ValueError(
+            "--coordinator requires --num-processes and --process-id "
+            "(or the DLLAMA_* env equivalents)"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=n, process_id=pid
+    )
+    return n
+
+
+class ControlPlane:
+    """Fixed-size int32 packet, broadcast from process 0 each engine call.
+
+    Layout: [op, lane, n, start_pos, payload_a[L], payload_b[L]] with
+    L = max(n_lanes, chunk). PREFILL: payload_a[:n] = prompt-chunk tokens.
+    DECODE: payload_a[:n_lanes] = tokens, payload_b[:n_lanes] = positions.
+    """
+
+    HEADER = 4
+
+    def __init__(self, n_lanes: int, chunk: int = 1024):
+        self.n_lanes = n_lanes
+        self.chunk = max(chunk, n_lanes)
+        self._size = self.HEADER + 2 * self.chunk
+
+    def _bcast(self, pkt: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.broadcast_one_to_all(pkt))
+
+    def _send(self, op: int, lane: int, n: int, start_pos: int, a, b=None) -> None:
+        pkt = np.zeros(self._size, np.int32)
+        pkt[0:4] = (op, lane, n, start_pos)
+        if a is not None:
+            pkt[self.HEADER : self.HEADER + len(a)] = a
+        if b is not None:
+            pkt[self.HEADER + self.chunk : self.HEADER + self.chunk + len(b)] = b
+        self._bcast(pkt)
+
+    def send_prefill(self, lane: int, tokens, start_pos: int) -> None:
+        for off in range(0, len(tokens), self.chunk):
+            part = tokens[off : off + self.chunk]
+            self._send(OP_PREFILL, lane, len(part), start_pos + off, part)
+
+    def send_decode(self, tokens: np.ndarray, positions: np.ndarray) -> None:
+        self._send(OP_DECODE, 0, len(tokens), 0, tokens, positions)
+
+    def send_stop(self) -> None:
+        self._send(OP_STOP, 0, 0, 0, None)
+
+    def recv(self) -> np.ndarray:
+        return self._bcast(np.zeros(self._size, np.int32))
+
+
+class RootControlEngine:
+    """Engine proxy for process 0: broadcasts the control packet, then makes
+    the identical engine call the workers will replay — the analogue of
+    RootLlmInference::forward's writeAll-then-forward (src/app.cpp:198-209).
+    """
+
+    def __init__(self, engine, plane: ControlPlane):
+        self._engine = engine
+        self._plane = plane
+
+    def __getattr__(self, name):  # stats, config, lane_logits, ...
+        return getattr(self._engine, name)
+
+    def prefill(self, lane: int, tokens, start_pos: int = 0):
+        # one packet, then the matching compute, per chunk: workers replay
+        # each packet with a blocking engine call, so broadcasting the whole
+        # prompt up front would deadlock the pod on prompts > plane.chunk
+        # (root stuck in the next broadcast, worker stuck in collectives the
+        # root never dispatched)
+        tokens = list(tokens)
+        chunk = self._plane.chunk
+        out = None
+        for off in range(0, len(tokens), chunk):
+            part = tokens[off : off + chunk]
+            self._plane.send_prefill(lane, part, start_pos + off)
+            out = self._engine.prefill(lane, part, start_pos=start_pos + off)
+        return out
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray):
+        self._plane.send_decode(
+            np.asarray(tokens, np.int32), np.asarray(positions, np.int32)
+        )
+        return self._engine.decode(tokens, positions)
+
+    def stop_workers(self) -> None:
+        self._plane.send_stop()
+
+
+def worker_loop(engine, plane: ControlPlane) -> None:
+    """Replay root-broadcast engine calls until OP_STOP — the SPMD twin of
+    runWorkerApp's poll-forward loop (src/app.cpp:405-464). Every process
+    (root included, via RootControlEngine) executes the same compiled steps
+    in the same order, so the global-mesh collectives line up."""
+    h = ControlPlane.HEADER
+    while True:
+        pkt = plane.recv()
+        op, lane, n, start_pos = (int(x) for x in pkt[:4])
+        if op == OP_STOP:
+            return
+        if op == OP_PREFILL:
+            engine.prefill(lane, [int(t) for t in pkt[h : h + n]], start_pos=start_pos)
+        elif op == OP_DECODE:
+            engine.decode(pkt[h : h + n], pkt[h + plane.chunk : h + plane.chunk + n])
+        else:
+            raise ValueError(f"unknown control op {op}")

@@ -62,6 +62,7 @@ class InferenceEngine:
         cache_dtype=jnp.float32,
         emulate_q80_activations: bool = False,
         mesh=None,
+        replicate_outputs: bool = False,
     ):
         self.config = config
         self.params = params
@@ -78,6 +79,18 @@ class InferenceEngine:
 
         sp_mesh = mesh
 
+        if replicate_outputs and mesh is not None:
+            # multi-host: logits/greedy must come back fully replicated, or
+            # no process can fetch them (a cross-host-sharded jax.Array is
+            # not locally convertible; the reference instead gathers logits
+            # to its root over TCP, SYNC_NODE_SLICES_EXCEPT_ROOT)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+            replicate = lambda x: jax.lax.with_sharding_constraint(x, rep)
+        else:
+            replicate = lambda x: x
+
         @partial(jax.jit, donate_argnums=(1,))
         def _decode(params, cache, tokens, positions):
             # tokens/positions: [n_lanes] -> [n_lanes, 1]
@@ -85,7 +98,12 @@ class InferenceEngine:
                 cfg, params, tokens[:, None], positions[:, None], cache,
                 emulate_q80_activations=q80, mesh=sp_mesh,
             )
-            return logits[:, 0, :], jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32), cache
+            step = logits[:, 0, :]
+            return (
+                replicate(step),
+                replicate(jnp.argmax(step, axis=-1).astype(jnp.int32)),
+                cache,
+            )
 
         @partial(jax.jit, donate_argnums=(1,))
         def _prefill(params, cache, lane, tokens, start_pos, n_tokens):
@@ -111,7 +129,11 @@ class InferenceEngine:
             k = jax.lax.dynamic_update_slice_in_dim(cache.k, lane_cache.k, lane, axis=1)
             v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)
             last = jax.lax.dynamic_index_in_dim(logits[0], n_tokens - 1, axis=0, keepdims=False)
-            return last, jnp.argmax(last).astype(jnp.int32), KVCache(k=k, v=v)
+            return (
+                replicate(last),
+                replicate(jnp.argmax(last).astype(jnp.int32)),
+                KVCache(k=k, v=v),
+            )
 
         self._decode_fn = _decode
         self._prefill_fn = _prefill

@@ -1,0 +1,165 @@
+"""Multi-host SPMD serving: two real jax.distributed processes on CPU.
+
+The reference's cluster story is a worker binary that receives its program
+over TCP (src/app.cpp:405-464); here it is multi-controller SPMD — every
+process runs the same engine, the root broadcasts a control packet per call
+(parallel/multihost.ControlPlane, the LlmControlPacket analogue), workers
+replay it. This test launches an actual 2-process pod (coordinator on
+localhost, one virtual CPU device per process, global mesh tp=2), generates
+greedily through the RootControlEngine, and asserts the tokens match a
+single-process run of the same model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVER = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    mode, tmp, port = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    from distributed_llama_multiusers_tpu.utils.testing import force_cpu_mesh
+    force_cpu_mesh(n_devices=1)  # one local device; the pod supplies 2 globally
+
+    from distributed_llama_multiusers_tpu.parallel.multihost import (
+        ControlPlane, RootControlEngine, maybe_initialize_distributed, worker_loop,
+    )
+    os.environ["DLLAMA_COORDINATOR"] = f"127.0.0.1:{{port}}"
+    os.environ["DLLAMA_NUM_PROCESSES"] = "2"
+    os.environ["DLLAMA_PROCESS_ID"] = "0" if mode == "root" else "1"
+    assert maybe_initialize_distributed() == 2
+
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 2, jax.devices()
+
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.models.loader import load_params_from_m
+    from distributed_llama_multiusers_tpu.parallel import MeshPlan, make_mesh
+    from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.tokenizer import Tokenizer
+
+    h = load_model_header(os.path.join(tmp, "m.m"))
+    config, params = load_params_from_m(os.path.join(tmp, "m.m"), h, dtype=jnp.float32)
+    mesh = make_mesh(MeshPlan(tp=2))
+    params = shard_params(params, mesh)
+    engine = InferenceEngine(
+        config, params, n_lanes=2, mesh=mesh, replicate_outputs=True
+    )
+    plane = ControlPlane(2, chunk=64)
+
+    if mode == "root":
+        eng = RootControlEngine(engine, plane)
+        t = Tokenizer(os.path.join(tmp, "t.t"))
+        ids = t.encode("hello world")
+        _, greedy, pos = eng.prefill(0, ids)
+        out = [greedy]
+        cur = greedy
+        toks = np.zeros(2, np.int32); poss = np.zeros(2, np.int32)
+        for _ in range(5):
+            toks[0] = cur; poss[0] = pos
+            _, g = eng.decode(toks, poss)
+            pos += 1
+            cur = int(g[0])
+            out.append(cur)
+        eng.stop_workers()
+        with open(os.path.join(tmp, "root_tokens.json"), "w") as f:
+            json.dump(out, f)
+    else:
+        worker_loop(engine, plane)
+    print(f"{{mode}} done", flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_pod_matches_single_process(tmp_path):
+    from distributed_llama_multiusers_tpu.formats.synthetic import (
+        tiny_header,
+        write_synthetic_model,
+        write_synthetic_tokenizer,
+    )
+
+    tmp = str(tmp_path)
+    header = tiny_header()
+    write_synthetic_model(os.path.join(tmp, "m.m"), header, seed=7)
+    write_synthetic_tokenizer(os.path.join(tmp, "t.t"), vocab_size=header.vocab_size)
+    driver = os.path.join(tmp, "driver.py")
+    with open(driver, "w") as f:
+        f.write(DRIVER.format(repo=REPO))
+
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # the pod must manage its own platform/devices (the suite's conftest
+        # exports an 8-device CPU config)
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, driver, mode, tmp, str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for mode in ("root", "worker")
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"pod process failed:\n{out[-2000:]}"
+
+    with open(os.path.join(tmp, "root_tokens.json")) as f:
+        pod_tokens = json.load(f)
+    assert len(pod_tokens) == 6
+
+    # single-process reference on the same files (this process, no mesh)
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.models.loader import load_params_from_m
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.tokenizer import Tokenizer
+    import numpy as np
+
+    h = load_model_header(os.path.join(tmp, "m.m"))
+    config, params = load_params_from_m(os.path.join(tmp, "m.m"), h, dtype=jnp.float32)
+    engine = InferenceEngine(config, params, n_lanes=2)
+    t = Tokenizer(os.path.join(tmp, "t.t"))
+    ids = t.encode("hello world")
+    _, greedy, pos = engine.prefill(0, ids)
+    want = [greedy]
+    cur = greedy
+    toks = np.zeros(2, np.int32)
+    poss = np.zeros(2, np.int32)
+    for _ in range(5):
+        toks[0] = cur
+        poss[0] = pos
+        _, g = engine.decode(toks, poss)
+        pos += 1
+        cur = int(g[0])
+        want.append(cur)
+
+    assert pod_tokens == want
