@@ -41,6 +41,17 @@ def run_inference(args) -> None:
     tokenizer.reset_decoder()
     out_pieces = []
     pred_times = []
+    # per-token sync readout on a mesh (reference Sync ms + Sent/Recv kB,
+    # src/dllama.cpp:54-64): payload bytes estimated from the compiled
+    # decode program's collectives (parallel/comm_stats)
+    sync_suffix = ""
+    if args.benchmark and getattr(engine, "mesh", None) is not None:
+        cstats = engine.collective_stats()
+        if cstats.get("total_bytes"):
+            sync_suffix = (
+                f"  Sync {cstats['total_bytes'] / 1024:8.1f} kB/chip"
+                f" ({cstats['n_collectives']} collectives)"
+            )
     # idle lanes beyond 0 are harmless (multi-host roots run max_lanes lanes
     # so every process compiles identical decode shapes)
     toks = np.zeros(engine.n_lanes, np.int32)
@@ -55,11 +66,11 @@ def run_inference(args) -> None:
         toks[0] = cur
         poss[0] = pos
         t1 = time.perf_counter()
-        logits_b, greedy_b = engine.decode(toks, poss)
+        logits_b, greedy_b, _ = engine.decode(toks, poss)
         dt = time.perf_counter() - t1
         pred_times.append(dt)
         if args.benchmark:
-            log("🔶", f"Pred {dt * 1000:8.2f} ms")
+            log("🔶", f"Pred {dt * 1000:8.2f} ms{sync_suffix}")
         pos += 1
         cur = int(greedy_b[0]) if args.temperature == 0.0 else sampler.sample(engine.lane_logits(logits_b, 0))
     print()
@@ -121,7 +132,7 @@ def run_chat(args) -> None:
                 detector.reset()
             toks[0] = cur
             poss[0] = pos
-            logits_b, greedy_b = engine.decode(toks, poss)
+            logits_b, greedy_b, _ = engine.decode(toks, poss)
             pos += 1
             cur = int(greedy_b[0]) if args.temperature == 0.0 else sampler.sample(engine.lane_logits(logits_b, 0))
         print()

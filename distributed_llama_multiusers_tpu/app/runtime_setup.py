@@ -98,7 +98,11 @@ def load_stack(args, n_lanes: int | None = None):
         # every process must compile identical programs: lane count comes
         # from --max-lanes on all hosts (n_lanes overrides are single-host)
         n_lanes=(n_lanes if n_proc == 1 else None) or args.max_lanes,
-        cache_dtype=jnp.float32,
+        # None -> bf16 KV on TPU, f32 on CPU (parity oracle); --kv-dtype
+        # overrides (e.g. f32 on TPU for strict-parity serving)
+        cache_dtype={"f32": jnp.float32, "bf16": jnp.bfloat16, "auto": None}[
+            getattr(args, "kv_dtype", "auto") or "auto"
+        ],
         emulate_q80_activations=emulate_q80,
         mesh=mesh,
         replicate_outputs=n_proc > 1,

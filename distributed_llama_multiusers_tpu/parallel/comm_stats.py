@@ -1,0 +1,93 @@
+"""Collective-traffic accounting from compiled XLA programs.
+
+The reference counts every byte its TCP sockets move and prints Sent/Recv kB
+per token (src/nn/nn-network.cpp:493-508, src/dllama.cpp:54-64). Under
+GSPMD the collectives live inside the compiled executable, so the equivalent
+observability comes from the post-partitioning HLO: every all-reduce /
+all-gather / reduce-scatter / collective-permute op is visible there with
+its per-chip output shape. This module parses them into a byte estimate —
+an honest static analogue of the reference's measured socket counters
+(payload bytes per chip per step; wire/ICI overheads not included).
+"""
+
+from __future__ import annotations
+
+import re
+
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s4": 1, "s8": 1, "s16": 2, "s32": 4, "s64": 8,
+    "u4": 1, "u8": 1, "u16": 2, "u32": 4, "u64": 8,
+    "f8e4m3fn": 1, "f8e5m2": 1,
+    "bf16": 2, "f16": 2, "f32": 4, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# e.g. `%all-reduce.3 = f32[8,2048]{1,0} all-reduce(` or a tuple shape
+_OP_RE = re.compile(
+    r"=\s*(?:\(([^)]*)\)|(\w+\[[\d,]*\])(?:\{[^}]*\})?)\s*"
+    r"(" + "|".join(_COLLECTIVES) + r")(-start|-done)?\("
+)
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def collective_stats_from_hlo(hlo_text: str) -> dict:
+    """Parse post-SPMD HLO text into per-collective byte totals.
+
+    Bytes counted are each collective's OUTPUT payload on one chip (for
+    all-gather that is the received data; for reduce-scatter the reduced
+    shard; for all-reduce the full reduced tensor)."""
+    per_kind: dict[str, int] = {}
+    counts: dict[str, int] = {}
+    total = 0
+    n_ops = 0
+    for m in _OP_RE.finditer(hlo_text):
+        tuple_body, single, kind, suffix = m.groups()
+        # async -start/-done pairs would double count; count the -start only
+        if suffix == "-done":
+            continue
+        shapes = _SHAPE_RE.findall(tuple_body if tuple_body else single)
+        sizes = [_shape_bytes(dt, dims) for dt, dims in shapes]
+        if suffix == "-start" and tuple_body:
+            # async-start outputs carry (operand, result, contexts...): the
+            # payload is the largest buffer, not the tuple sum
+            nbytes = max(sizes, default=0)
+        else:
+            nbytes = sum(sizes)
+        per_kind[kind] = per_kind.get(kind, 0) + nbytes
+        counts[kind] = counts.get(kind, 0) + 1
+        total += nbytes
+        n_ops += 1
+    return {
+        "total_bytes": total,
+        "n_collectives": n_ops,
+        "bytes_by_kind": per_kind,
+        "count_by_kind": counts,
+    }
+
+
+def collective_stats_of(jitted_fn, *args, **kwargs) -> dict:
+    """Compile (cached by jax where possible) and analyze a jitted function's
+    collective traffic for the given example arguments."""
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    try:
+        text = compiled.as_text()
+    except Exception:  # some backends restrict HLO dumps
+        return {"total_bytes": 0, "n_collectives": 0, "error": "hlo unavailable"}
+    return collective_stats_from_hlo(text)

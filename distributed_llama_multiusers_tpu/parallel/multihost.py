@@ -63,30 +63,39 @@ def maybe_initialize_distributed(args=None) -> int:
 class ControlPlane:
     """Fixed-size int32 packet, broadcast from process 0 each engine call.
 
-    Layout: [op, lane, n, start_pos, payload_a[L], payload_b[L]] with
+    Layout: [op, lane, n, start_pos, payload_a[L] .. payload_e[L]] with
     L = max(n_lanes, chunk). PREFILL: payload_a[:n] = prompt-chunk tokens.
-    DECODE: payload_a[:n_lanes] = tokens, payload_b[:n_lanes] = positions.
+    DECODE: payload_a = tokens, payload_b = positions, payload_c/d =
+    temperatures/top-p as float32 bit patterns, payload_e = sampler seeds —
+    every process must dispatch the identical compiled decode (sampling is
+    fused into it), so the sampling arguments ride the control packet the
+    way position/batchSize ride LlmControlPacket (src/app.cpp:198-209).
     """
 
     HEADER = 4
+    SLOTS = 5
 
     def __init__(self, n_lanes: int, chunk: int = 1024):
         self.n_lanes = n_lanes
         self.chunk = max(chunk, n_lanes)
-        self._size = self.HEADER + 2 * self.chunk
+        self._size = self.HEADER + self.SLOTS * self.chunk
 
     def _bcast(self, pkt: np.ndarray) -> np.ndarray:
         from jax.experimental import multihost_utils
 
         return np.asarray(multihost_utils.broadcast_one_to_all(pkt))
 
-    def _send(self, op: int, lane: int, n: int, start_pos: int, a, b=None) -> None:
+    def slot(self, pkt: np.ndarray, i: int, n: int) -> np.ndarray:
+        start = self.HEADER + i * self.chunk
+        return pkt[start : start + n]
+
+    def _send(self, op: int, lane: int, n: int, start_pos: int, *payloads) -> None:
         pkt = np.zeros(self._size, np.int32)
         pkt[0:4] = (op, lane, n, start_pos)
-        if a is not None:
-            pkt[self.HEADER : self.HEADER + len(a)] = a
-        if b is not None:
-            pkt[self.HEADER + self.chunk : self.HEADER + self.chunk + len(b)] = b
+        for i, payload in enumerate(payloads):
+            if payload is not None:
+                start = self.HEADER + i * self.chunk
+                pkt[start : start + len(payload)] = payload
         self._bcast(pkt)
 
     def send_prefill(self, lane: int, tokens, start_pos: int) -> None:
@@ -94,11 +103,21 @@ class ControlPlane:
             part = tokens[off : off + self.chunk]
             self._send(OP_PREFILL, lane, len(part), start_pos + off, part)
 
-    def send_decode(self, tokens: np.ndarray, positions: np.ndarray) -> None:
-        self._send(OP_DECODE, 0, len(tokens), 0, tokens, positions)
+    def send_decode(
+        self, tokens, positions, temps=None, topps=None, seeds=None
+    ) -> None:
+        n = len(tokens)
+        as_bits = lambda f: (
+            None if f is None else np.asarray(f, np.float32).view(np.int32)
+        )
+        self._send(
+            OP_DECODE, 0, n, 0,
+            tokens, positions, as_bits(temps), as_bits(topps),
+            None if seeds is None else np.asarray(seeds, np.uint32).view(np.int32),
+        )
 
     def send_stop(self) -> None:
-        self._send(OP_STOP, 0, 0, 0, None)
+        self._send(OP_STOP, 0, 0, 0)
 
     def recv(self) -> np.ndarray:
         return self._bcast(np.zeros(self._size, np.int32))
@@ -117,6 +136,10 @@ class RootControlEngine:
     def __getattr__(self, name):  # stats, config, lane_logits, ...
         return getattr(self._engine, name)
 
+    def prefill_chunk(self, lane: int, chunk, start_pos: int):
+        self._plane.send_prefill(lane, list(chunk), start_pos)
+        return self._engine.prefill_chunk(lane, list(chunk), start_pos)
+
     def prefill(self, lane: int, tokens, start_pos: int = 0):
         # one packet, then the matching compute, per chunk: workers replay
         # each packet with a blocking engine call, so broadcasting the whole
@@ -132,11 +155,18 @@ class RootControlEngine:
             out = self._engine.prefill(lane, part, start_pos=start_pos + off)
         return out
 
-    def decode(self, tokens: np.ndarray, positions: np.ndarray):
+    def decode(self, tokens, positions, temps=None, topps=None, seeds=None):
+        # normalize sampling args HERE so the packet and the root's engine
+        # call carry byte-identical values (workers replay from the packet)
+        n = self._engine.n_lanes
+        temps = np.zeros(n, np.float32) if temps is None else np.asarray(temps, np.float32)
+        topps = np.full(n, 0.9, np.float32) if topps is None else np.asarray(topps, np.float32)
+        seeds = np.zeros(n, np.uint32) if seeds is None else np.asarray(seeds, np.uint32)
         self._plane.send_decode(
-            np.asarray(tokens, np.int32), np.asarray(positions, np.int32)
+            np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
+            temps, topps, seeds,
         )
-        return self._engine.decode(tokens, positions)
+        return self._engine.decode(tokens, positions, temps, topps, seeds)
 
     def stop_workers(self) -> None:
         self._plane.send_stop()
@@ -147,15 +177,20 @@ def worker_loop(engine, plane: ControlPlane) -> None:
     runWorkerApp's poll-forward loop (src/app.cpp:405-464). Every process
     (root included, via RootControlEngine) executes the same compiled steps
     in the same order, so the global-mesh collectives line up."""
-    h = ControlPlane.HEADER
     while True:
         pkt = plane.recv()
         op, lane, n, start_pos = (int(x) for x in pkt[:4])
         if op == OP_STOP:
             return
         if op == OP_PREFILL:
-            engine.prefill(lane, [int(t) for t in pkt[h : h + n]], start_pos=start_pos)
+            engine.prefill(lane, [int(t) for t in plane.slot(pkt, 0, n)], start_pos=start_pos)
         elif op == OP_DECODE:
-            engine.decode(pkt[h : h + n], pkt[h + plane.chunk : h + plane.chunk + n])
+            engine.decode(
+                plane.slot(pkt, 0, n),
+                plane.slot(pkt, 1, n),
+                plane.slot(pkt, 2, n).view(np.float32),
+                plane.slot(pkt, 3, n).view(np.float32),
+                plane.slot(pkt, 4, n).view(np.uint32),
+            )
         else:
             raise ValueError(f"unknown control op {op}")

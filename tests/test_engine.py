@@ -44,7 +44,7 @@ def test_prefill_then_decode_matches_oracle(stack):
         out.append(cur)
         tokens[0] = cur
         positions[0] = pos
-        logits2, g = engine.decode(tokens, positions)
+        logits2, g, _ = engine.decode(tokens, positions)
         cur = int(g[0])
         pos += 1
     assert out == ref

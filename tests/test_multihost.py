@@ -70,7 +70,7 @@ DRIVER = textwrap.dedent(
         toks = np.zeros(2, np.int32); poss = np.zeros(2, np.int32)
         for _ in range(5):
             toks[0] = cur; poss[0] = pos
-            _, g = eng.decode(toks, poss)
+            _, g, _ = eng.decode(toks, poss)
             pos += 1
             cur = int(g[0])
             out.append(cur)
@@ -157,7 +157,7 @@ def test_two_process_pod_matches_single_process(tmp_path):
     for _ in range(5):
         toks[0] = cur
         poss[0] = pos
-        _, g = engine.decode(toks, poss)
+        _, g, _ = engine.decode(toks, poss)
         pos += 1
         cur = int(g[0])
         want.append(cur)

@@ -138,7 +138,7 @@ def test_engine_with_sp_mesh_matches_meshfree(mesh222):
         positions = np_.zeros(4, np_.int32)
         for _ in range(4):
             tokens[1], positions[1] = toks[-1], pos
-            _, greedy = engine.decode(tokens, positions)
+            _, greedy, _ = engine.decode(tokens, positions)
             toks.append(int(greedy[1]))
             pos += 1
         return toks
