@@ -22,7 +22,7 @@ import numpy as np
 
 from ..tokenizer import ChatItem, EosDetector, EosResult, Sampler, TokenizerChatStops, chat_generator_for
 from .args import build_parser
-from .runtime_setup import load_stack, log
+from .runtime_setup import honor_cpu_platform_env, load_stack, log
 
 
 def run_inference(args) -> None:
@@ -151,7 +151,7 @@ def run_worker(args) -> None:
     """
     import os
 
-    from ..parallel.multihost import worker_loop
+    from ..parallel.multihost import worker_serve
 
     if not (args.coordinator or os.environ.get("DLLAMA_COORDINATOR")):
         log("⭕", "Single process: no pod to join (pass --coordinator/--num-processes/--process-id).")
@@ -161,11 +161,12 @@ def run_worker(args) -> None:
     plane = getattr(engine, "control_plane", None)
     assert plane is not None, "coordinator flags set but pod join failed"
     log("⭕", "Worker ready; replaying root engine calls")
-    worker_loop(engine, plane)
+    worker_serve(engine, plane, log=lambda m: log("⭕", m))
     log("⭕", "Root sent stop; worker exiting")
 
 
 def main(argv=None) -> None:
+    honor_cpu_platform_env()
     args = build_parser("dllama").parse_args(argv)
     if args.mode == "inference":
         run_inference(args)

@@ -10,10 +10,11 @@ import signal
 from ..server import ApiServer
 from ..tokenizer import template_type_from_name
 from .args import build_parser
-from .runtime_setup import load_stack, log, make_scheduler
+from .runtime_setup import honor_cpu_platform_env, load_stack, log, make_scheduler
 
 
 def main(argv=None) -> None:
+    honor_cpu_platform_env()
     args = build_parser("dllama-api", api=True).parse_args(argv)
     config, params, tokenizer, engine = load_stack(args)
     scheduler = make_scheduler(engine, tokenizer)

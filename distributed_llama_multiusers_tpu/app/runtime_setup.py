@@ -23,6 +23,28 @@ def log(emoji: str, msg: str) -> None:
     print(f"{emoji} {msg}", flush=True)
 
 
+def honor_cpu_platform_env() -> None:
+    """Make `JAX_PLATFORMS=cpu dllama ...` actually run on CPU. Some hosts
+    (this one included) register a TPU PJRT plugin at interpreter start whose
+    discovery blocks on a network tunnel even when the platform filter says
+    cpu, so the env var alone hangs the CLI; route through force_cpu_mesh,
+    which also drops the non-cpu plugin factories. Device count comes from
+    xla_force_host_platform_device_count in XLA_FLAGS (default 1). Must run
+    before the first jax device/backend call."""
+    import os
+    import re
+
+    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return
+    from ..utils.testing import force_cpu_mesh
+
+    m = re.search(
+        r"xla_force_host_platform_device_count=(\d+)",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    force_cpu_mesh(n_devices=int(m.group(1)) if m else 1)
+
+
 def load_stack(args, n_lanes: int | None = None):
     """Returns (config, params, tokenizer, engine).
 

@@ -64,7 +64,10 @@ class ControlPlane:
     """Fixed-size int32 packet, broadcast from process 0 each engine call.
 
     Layout: [op, lane, n, start_pos, payload_a[L] .. payload_e[L]] with
-    L = max(n_lanes, chunk). PREFILL: payload_a[:n] = prompt-chunk tokens.
+    L = max(n_lanes, chunk). PREFILL: payload_a[:n] = prompt-chunk tokens,
+    payload_b/c[0] = temperature/top-p float32 bit patterns, payload_d[0] =
+    sampler seed (first-token sampling is fused into the compiled prefill,
+    so its scalar operands must be byte-identical on every process).
     DECODE: payload_a = tokens, payload_b = positions, payload_c/d =
     temperatures/top-p as float32 bit patterns, payload_e = sampler seeds —
     every process must dispatch the identical compiled decode (sampling is
@@ -98,10 +101,19 @@ class ControlPlane:
                 pkt[start : start + len(payload)] = payload
         self._bcast(pkt)
 
-    def send_prefill(self, lane: int, tokens, start_pos: int) -> None:
+    def send_prefill(
+        self, lane: int, tokens, start_pos: int,
+        temp: float = 0.0, topp: float = 0.9, seed: int = 0,
+    ) -> None:
+        tbits = np.asarray([temp], np.float32).view(np.int32)
+        pbits = np.asarray([topp], np.float32).view(np.int32)
+        sbits = np.asarray([seed & 0xFFFFFFFF], np.uint32).view(np.int32)
         for off in range(0, len(tokens), self.chunk):
             part = tokens[off : off + self.chunk]
-            self._send(OP_PREFILL, lane, len(part), start_pos + off, part)
+            self._send(
+                OP_PREFILL, lane, len(part), start_pos + off,
+                part, tbits, pbits, sbits,
+            )
 
     def send_decode(
         self, tokens, positions, temps=None, topps=None, seeds=None
@@ -136,11 +148,32 @@ class RootControlEngine:
     def __getattr__(self, name):  # stats, config, lane_logits, ...
         return getattr(self._engine, name)
 
-    def prefill_chunk(self, lane: int, chunk, start_pos: int):
-        self._plane.send_prefill(lane, list(chunk), start_pos)
-        return self._engine.prefill_chunk(lane, list(chunk), start_pos)
+    def prefill_chunk(
+        self, lane: int, chunk, start_pos: int,
+        temp: float = 0.0, topp: float = 0.9, seed: int = 0,
+    ):
+        # validate BEFORE broadcasting: every packet must pair with exactly
+        # one root-side compute, or workers dispatch collective programs the
+        # root never runs and the pod deadlocks. Empty chunks send 0 packets;
+        # chunks over plane.chunk split into >1; chunks over the engine's
+        # bucket make the root raise after the packet went out.
+        limit = min(self._plane.chunk, self._engine.max_chunk())
+        if not 1 <= len(chunk) <= limit:
+            raise ValueError(
+                f"prefill chunk of {len(chunk)} outside [1, {limit}] "
+                f"(plane packet capacity {self._plane.chunk}, engine bucket "
+                f"{self._engine.max_chunk()}); size ControlPlane(chunk=...) "
+                f">= engine.max_chunk()"
+            )
+        self._plane.send_prefill(lane, list(chunk), start_pos, temp, topp, seed)
+        return self._engine.prefill_chunk(
+            lane, list(chunk), start_pos, temp=temp, topp=topp, seed=seed
+        )
 
-    def prefill(self, lane: int, tokens, start_pos: int = 0):
+    def prefill(
+        self, lane: int, tokens, start_pos: int = 0,
+        temp: float = 0.0, topp: float = 0.9, seed: int = 0,
+    ):
         # one packet, then the matching compute, per chunk: workers replay
         # each packet with a blocking engine call, so broadcasting the whole
         # prompt up front would deadlock the pod on prompts > plane.chunk
@@ -151,8 +184,11 @@ class RootControlEngine:
         out = None
         for off in range(0, len(tokens), chunk):
             part = tokens[off : off + chunk]
-            self._plane.send_prefill(lane, part, start_pos + off)
-            out = self._engine.prefill(lane, part, start_pos=start_pos + off)
+            self._plane.send_prefill(lane, part, start_pos + off, temp, topp, seed)
+            out = self._engine.prefill(
+                lane, part, start_pos=start_pos + off,
+                temp=temp, topp=topp, seed=seed,
+            )
         return out
 
     def decode(self, tokens, positions, temps=None, topps=None, seeds=None):
@@ -183,7 +219,14 @@ def worker_loop(engine, plane: ControlPlane) -> None:
         if op == OP_STOP:
             return
         if op == OP_PREFILL:
-            engine.prefill(lane, [int(t) for t in plane.slot(pkt, 0, n)], start_pos=start_pos)
+            engine.prefill(
+                lane,
+                [int(t) for t in plane.slot(pkt, 0, n)],
+                start_pos=start_pos,
+                temp=float(plane.slot(pkt, 1, 1).view(np.float32)[0]),
+                topp=float(plane.slot(pkt, 2, 1).view(np.float32)[0]),
+                seed=int(plane.slot(pkt, 3, 1).view(np.uint32)[0]),
+            )
         elif op == OP_DECODE:
             engine.decode(
                 plane.slot(pkt, 0, n),
@@ -194,3 +237,30 @@ def worker_loop(engine, plane: ControlPlane) -> None:
             )
         else:
             raise ValueError(f"unknown control op {op}")
+
+
+def worker_serve(engine, plane: ControlPlane, max_restarts: int | None = 3,
+                 log=print) -> None:
+    """Supervised worker: re-enter ``worker_loop`` after a replay error — the
+    analogue of runWorkerApp's outer loop, which catches exceptions and
+    re-``serve()``s instead of exiting (src/app.cpp:455-463). A worker that
+    dies mid-collective cannot rejoin that collective, but a host-side replay
+    failure (malformed packet, argument validation) should not take the pod
+    process down: log, resubscribe to the control stream, and keep replaying.
+
+    ``max_restarts`` is deliberately finite by default: an error raised AFTER
+    the root dispatched its half of a collective leaves the pod desynced, and
+    a worker that retries forever would turn that into a silent hang instead
+    of a process death that jax.distributed's peer-failure detection surfaces.
+    Bounded retries absorb pre-dispatch failures (the common, recoverable
+    kind) while still crashing out of a persistent desync."""
+    restarts = 0
+    while True:
+        try:
+            worker_loop(engine, plane)
+            return
+        except Exception as e:  # noqa: BLE001 — supervised restart boundary
+            restarts += 1
+            log(f"worker replay error (restart {restarts}): {e!r}")
+            if max_restarts is not None and restarts > max_restarts:
+                raise

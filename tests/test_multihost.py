@@ -21,7 +21,7 @@ import textwrap
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-DRIVER = textwrap.dedent(
+POD_PREAMBLE = textwrap.dedent(
     """
     import json, os, sys
     sys.path.insert(0, {repo!r})
@@ -31,7 +31,8 @@ DRIVER = textwrap.dedent(
     force_cpu_mesh(n_devices=1)  # one local device; the pod supplies 2 globally
 
     from distributed_llama_multiusers_tpu.parallel.multihost import (
-        ControlPlane, RootControlEngine, maybe_initialize_distributed, worker_loop,
+        ControlPlane, RootControlEngine, maybe_initialize_distributed,
+        worker_loop, worker_serve,
     )
     os.environ["DLLAMA_COORDINATOR"] = f"127.0.0.1:{{port}}"
     os.environ["DLLAMA_NUM_PROCESSES"] = "2"
@@ -59,7 +60,11 @@ DRIVER = textwrap.dedent(
         config, params, n_lanes=2, mesh=mesh, replicate_outputs=True
     )
     plane = ControlPlane(2, chunk=64)
+    """
+)
 
+DRIVER = POD_PREAMBLE + textwrap.dedent(
+    """
     if mode == "root":
         eng = RootControlEngine(engine, plane)
         t = Tokenizer(os.path.join(tmp, "t.t"))
@@ -83,6 +88,33 @@ DRIVER = textwrap.dedent(
     """
 )
 
+SCHED_DRIVER = POD_PREAMBLE + textwrap.dedent(
+    """
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler, Request,
+    )
+
+    if mode == "root":
+        eng = RootControlEngine(engine, plane)
+        t = Tokenizer(os.path.join(tmp, "t.t"))
+        sched = ContinuousBatchingScheduler(eng, t)
+        sched.start()
+        req = Request(
+            prompt="hello world", max_tokens=6, temperature=0.7, seed=1234
+        )
+        sched.submit(req)
+        req.future.result(timeout=300)
+        sched.stop()
+        eng.stop_workers()
+        assert req.error is None, req.error
+        with open(os.path.join(tmp, "root_sched_tokens.json"), "w") as f:
+            json.dump(req.generated_tokens, f)
+    else:
+        worker_serve(engine, plane, max_restarts=0)
+    print(f"{{mode}} done", flush=True)
+    """
+)
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -90,21 +122,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_pod_matches_single_process(tmp_path):
-    from distributed_llama_multiusers_tpu.formats.synthetic import (
-        tiny_header,
-        write_synthetic_model,
-        write_synthetic_tokenizer,
-    )
-
-    tmp = str(tmp_path)
-    header = tiny_header()
-    write_synthetic_model(os.path.join(tmp, "m.m"), header, seed=7)
-    write_synthetic_tokenizer(os.path.join(tmp, "t.t"), vocab_size=header.vocab_size)
+def _run_pod(tmp: str, driver_src: str, timeout: float = 420) -> None:
+    """Write the driver, launch root+worker subprocesses against a free
+    coordinator port, and assert both exit 0."""
     driver = os.path.join(tmp, "driver.py")
     with open(driver, "w") as f:
-        f.write(DRIVER.format(repo=REPO))
-
+        f.write(driver_src.format(repo=REPO))
     port = _free_port()
     env = {
         k: v
@@ -123,13 +146,27 @@ def test_two_process_pod_matches_single_process(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         for p in procs:
             p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"pod process failed:\n{out[-2000:]}"
+
+
+def test_two_process_pod_matches_single_process(tmp_path):
+    from distributed_llama_multiusers_tpu.formats.synthetic import (
+        tiny_header,
+        write_synthetic_model,
+        write_synthetic_tokenizer,
+    )
+
+    tmp = str(tmp_path)
+    header = tiny_header()
+    write_synthetic_model(os.path.join(tmp, "m.m"), header, seed=7)
+    write_synthetic_tokenizer(os.path.join(tmp, "t.t"), vocab_size=header.vocab_size)
+    _run_pod(tmp, DRIVER)
 
     with open(os.path.join(tmp, "root_tokens.json")) as f:
         pod_tokens = json.load(f)
@@ -163,3 +200,60 @@ def test_two_process_pod_matches_single_process(tmp_path):
         want.append(cur)
 
     assert pod_tokens == want
+
+
+def test_two_process_pod_scheduler_sampled_matches_mesh(tmp_path):
+    """The full serving path on a pod: ContinuousBatchingScheduler on the
+    root driving a RootControlEngine with a SAMPLED request (temp>0, fixed
+    seed), workers replaying PREFILL/DECODE packets that now carry the
+    sampling scalars — the round-3 regression (prefill_chunk TypeError +
+    divergent replicated sampling operands) stays fixed. Parity oracle: the
+    same scheduler over the same tp=2 GSPMD program in ONE process (this
+    one, on the suite's virtual CPU devices)."""
+    from distributed_llama_multiusers_tpu.formats.synthetic import (
+        tiny_header,
+        write_synthetic_model,
+        write_synthetic_tokenizer,
+    )
+
+    tmp = str(tmp_path)
+    header = tiny_header()
+    write_synthetic_model(os.path.join(tmp, "m.m"), header, seed=7)
+    write_synthetic_tokenizer(os.path.join(tmp, "t.t"), vocab_size=header.vocab_size)
+    _run_pod(tmp, SCHED_DRIVER)
+
+    with open(os.path.join(tmp, "root_sched_tokens.json")) as f:
+        pod_tokens = json.load(f)
+    assert len(pod_tokens) == 6
+
+    # single-process oracle: identical tp=2 mesh + scheduler + request
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.models.loader import load_params_from_m
+    from distributed_llama_multiusers_tpu.parallel import MeshPlan, make_mesh
+    from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+    from distributed_llama_multiusers_tpu.tokenizer import Tokenizer
+
+    h = load_model_header(os.path.join(tmp, "m.m"))
+    config, params = load_params_from_m(os.path.join(tmp, "m.m"), h, dtype=jnp.float32)
+    mesh = make_mesh(MeshPlan(tp=2))
+    params = shard_params(params, mesh)
+    engine = InferenceEngine(
+        config, params, n_lanes=2, mesh=mesh, replicate_outputs=True
+    )
+    t = Tokenizer(os.path.join(tmp, "t.t"))
+    sched = ContinuousBatchingScheduler(engine, t)
+    sched.start()
+    req = Request(prompt="hello world", max_tokens=6, temperature=0.7, seed=1234)
+    sched.submit(req)
+    req.future.result(timeout=300)
+    sched.stop()
+    assert req.error is None, req.error
+
+    assert pod_tokens == req.generated_tokens
