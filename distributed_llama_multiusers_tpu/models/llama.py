@@ -98,41 +98,121 @@ def _use_sp(mesh, b: int, t: int | None = None) -> bool:
     return t is None or t % mesh.shape["sp"] == 0
 
 
-def _moe_router_weights(y: jnp.ndarray, moe_gate: jnp.ndarray, n_active: int) -> jnp.ndarray:
-    """Dense routing weights [B, T, E]: softmax over the top-k router logits,
-    zero for unselected experts (Mixtral semantics; the reference carries
-    n_experts in its header but never executes MoE — SURVEY.md §2.4). The
-    router reads the unquantized normed activations."""
+def _moe_topk(y: jnp.ndarray, moe_gate: jnp.ndarray, n_active: int):
+    """Router top-k: returns (weights [B,T,k] f32 — softmax renormalized over
+    the selected k, Mixtral semantics — and expert ids [B,T,k] int32). The
+    router reads the unquantized normed activations. The reference carries
+    n_experts in its header but never executes MoE — SURVEY.md §2.4."""
     logits = jnp.einsum(
         "btd,de->bte", y.astype(jnp.float32), moe_gate.astype(jnp.float32)
     )
     vals, idx = jax.lax.top_k(logits, n_active)
-    w = jax.nn.softmax(vals, axis=-1)  # renormalize over the selected k
-    onehot = jax.nn.one_hot(idx, logits.shape[-1], dtype=w.dtype)  # [B,T,k,E]
+    return jax.nn.softmax(vals, axis=-1), idx
+
+
+def _moe_router_weights(y: jnp.ndarray, moe_gate: jnp.ndarray, n_active: int) -> jnp.ndarray:
+    """Dense routing weights [B, T, E]: top-k weights scattered over the
+    expert axis, zero for unselected experts."""
+    w, idx = _moe_topk(y, moe_gate, n_active)
+    onehot = jax.nn.one_hot(idx, moe_gate.shape[-1], dtype=w.dtype)  # [B,T,k,E]
     return jnp.einsum("btk,btke->bte", w, onehot)
 
 
-def _moe_ffn(y, yq, lp, act_fn, n_active: int, maybe_qdq, ep_sharded: bool = False):
-    """Gated-FFN mixture: every expert computes (dense dispatch — static
-    shapes, no data-dependent gather; selection happens through the zero
-    routing weights), outputs combined by router weight. Under an ep-sharded
-    mesh the expert axis of the einsums partitions and XLA inserts the psum
-    at the final reduction.
+def _moe_ffn_sparse(yq, topw, topi, w1, w2, w3, act_fn, maybe_qdq):
+    """Exact sparse top-k dispatch via grouped matmuls: the B*T*k
+    (token, expert) assignments are sorted by expert and each expert
+    multiplies only its own contiguous row group (``lax.ragged_dot`` — the
+    MXU-native MoE primitive; static shapes, no capacity, no token drops).
+    Per-token FFN FLOPs scale with k = n_active, not E, unlike a dense
+    dispatch that runs every expert on every token."""
+    b, t, d = yq.shape
+    e, k = w1.shape[0], topi.shape[-1]
+    n = b * t
+    x_flat = yq.reshape(n, d)
+    expert_flat = topi.reshape(n * k)
+    token_flat = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    weight_flat = topw.reshape(n * k)
+    order = jnp.argsort(expert_flat)  # stable: ties keep token order
+    tok_sorted = token_flat[order]
+    xs = x_flat[tok_sorted]  # [n*k, d]
+    group_sizes = jnp.zeros((e,), jnp.int32).at[expert_flat].add(1)
+    g = act_fn(jax.lax.ragged_dot(xs, w1, group_sizes))
+    u = jax.lax.ragged_dot(xs, w3, group_sizes)
+    ds = jax.lax.ragged_dot(maybe_qdq(g * u), w2, group_sizes)  # [n*k, d]
+    contrib = ds * weight_flat[order][:, None].astype(ds.dtype)
+    out = jnp.zeros((n, d), ds.dtype).at[tok_sorted].add(contrib)
+    return out.reshape(b, t, d)
 
-    PackedQ40 expert stacks take a static per-expert loop when the Pallas
-    dequant-matmul is live and the expert axis is NOT mesh-sharded (the
-    per-expert 2D matmuls still partition over tp via
-    q40_matmul_partitioned): slicing an ep-sharded expert axis would
-    all-gather every expert's weights onto every shard, so with
-    ``ep_sharded`` the stacked planes are dequantized in place (elementwise,
-    partitions over ep) and flow through the einsum path."""
+
+def _moe_ffn_ep_packed(yq, rw, w1, w2, w3, act_fn, maybe_qdq, mesh):
+    """Expert-parallel MoE over PackedQ40 stacks WITHOUT dequantizing to
+    HBM: shard_map pins each device's resident experts (ep axis) and tp
+    slice, runs the dequant-in-matmul kernel per local expert, and psums the
+    routed partial sums over (ep, tp) — the EP-native layout where weights
+    never move, only the (small) activations are replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.linear import q40_matmul_local
+    from ..quants.packed import PackedQ40
+
+    e = w1.packed.shape[0]
+    ep = mesh.shape.get("ep", 1)
+    e_local = e // ep
+
+    def body(yq, rw, p1, s1, p2, s2, p3, s3):
+        ep_idx = jax.lax.axis_index("ep")
+        out = None
+        for el in range(e_local):
+            g = act_fn(q40_matmul_local(yq, PackedQ40(p1[el], s1[el])))
+            u = q40_matmul_local(yq, PackedQ40(p3[el], s3[el]))
+            d = q40_matmul_local(maybe_qdq(g * u), PackedQ40(p2[el], s2[el]))
+            w_e = jax.lax.dynamic_slice_in_dim(rw, ep_idx * e_local + el, 1, axis=-1)
+            term = d * w_e.astype(d.dtype)
+            out = term if out is None else out + term
+        return jax.lax.psum(out, ("ep", "tp"))
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(), P(),
+            P("ep", None, "tp"), P("ep", None, "tp"),  # w1 planes [E, din/2|32, h]
+            P("ep", "tp", None), P("ep", "tp", None),  # w2 planes [E, h/2|32, d]
+            P("ep", None, "tp"), P("ep", None, "tp"),  # w3 planes
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(yq, rw, w1.packed, w1.scales, w2.packed, w2.scales, w3.packed, w3.scales)
+
+
+def _moe_ffn(y, yq, lp, act_fn, n_active: int, maybe_qdq, ep_sharded: bool = False,
+             mesh=None):
+    """Gated-FFN mixture. Dispatch:
+
+    - dense expert weights, single shard: exact sparse grouped dispatch
+      (``_moe_ffn_sparse``) — FLOPs proportional to k, not E.
+    - PackedQ40 + Pallas, single shard: static per-expert dequant-in-matmul
+      loop (decode is weight-bandwidth-bound: every resident expert's bytes
+      are the cost, and they are read exactly once).
+    - PackedQ40 + Pallas, ep-sharded mesh: shard_map expert-parallel path
+      (``_moe_ffn_ep_packed``) — weights stay quantized and resident.
+    - otherwise (dense weights on an ep mesh, or no Pallas): dense-dispatch
+      einsums whose expert axis GSPMD partitions over ep; selection happens
+      through the zero routing weights."""
     from ..ops.linear import pallas_kernel_active
     from ..quants.packed import PackedQ40, unpack_q40
 
-    rw = _moe_router_weights(y, lp.moe_gate, n_active)  # [B,T,E] f32
     w1, w2, w3 = lp.w1, lp.w2, lp.w3
     if isinstance(w1, PackedQ40):
-        if pallas_kernel_active() and not ep_sharded:
+        # the ep shard_map path needs the mesh handle; callers that can't
+        # provide one (pipeline stages run under vmap, where shard_map does
+        # not nest) fall through to the unpack + einsum dispatch below
+        if pallas_kernel_active() and (not ep_sharded or mesh is not None):
+            rw = _moe_router_weights(y, lp.moe_gate, n_active)
+            if ep_sharded:
+                return _moe_ffn_ep_packed(
+                    yq, rw, w1, w2, w3, act_fn, maybe_qdq, mesh
+                )
             out = None
             for e in range(w1.packed.shape[0]):
                 g = act_fn(matmul(yq, PackedQ40(w1.packed[e], w1.scales[e])))
@@ -144,6 +224,10 @@ def _moe_ffn(y, yq, lp, act_fn, n_active: int, maybe_qdq, ep_sharded: bool = Fal
         w1 = unpack_q40(w1, yq.dtype)
         w2 = unpack_q40(w2, yq.dtype)
         w3 = unpack_q40(w3, yq.dtype)
+    if not ep_sharded:
+        topw, topi = _moe_topk(y, lp.moe_gate, n_active)
+        return _moe_ffn_sparse(yq, topw, topi, w1, w2, w3, act_fn, maybe_qdq)
+    rw = _moe_router_weights(y, lp.moe_gate, n_active)
     g = act_fn(jnp.einsum("btd,edh->bteh", yq, w1))
     u = jnp.einsum("btd,edh->bteh", yq, w3)
     d = jnp.einsum("bteh,ehd->bted", maybe_qdq(g * u), w2)
@@ -236,6 +320,7 @@ def llama_forward(
             d = _moe_ffn(
                 y, yq, lp, act_fn, h_cfg.n_active_experts, maybe_qdq,
                 ep_sharded=mesh is not None and mesh.shape.get("ep", 1) > 1,
+                mesh=mesh,
             )
         else:
             g = act_fn(matmul(yq, lp.w1))
@@ -274,13 +359,15 @@ def llama_forward_train(
     layer_step = train_layer_step_fn(
         config, params.rope_cos, params.rope_sin, mesh=mesh if use_sp else None,
         ep_sharded=mesh is not None and mesh.shape.get("ep", 1) > 1,
+        moe_mesh=mesh,
     )
     x, _ = jax.lax.scan(layer_step, x, params.layers)
     y = rms_norm(x, params.rms_final, eps)
     return matmul(y, params.wcls).astype(jnp.float32)
 
 
-def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None, ep_sharded=False):
+def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None,
+                        ep_sharded=False, moe_mesh=None):
     """The causal full-sequence transformer layer as a lax.scan step
     ``(x [B,T,dim], lp) -> (x, None)`` — shared by llama_forward_train and
     the pipeline-parallel schedule (parallel/pipeline.py). With ``mesh``,
@@ -321,7 +408,7 @@ def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None, ep_s
         if config.n_experts > 0:
             x = x + _moe_ffn(
                 y, y, lp, act_fn, config.n_active_experts, lambda v: v,
-                ep_sharded=ep_sharded,
+                ep_sharded=ep_sharded, mesh=moe_mesh,
             )
         else:
             x = x + matmul(act_fn(matmul(y, lp.w1)) * matmul(y, lp.w3), lp.w2)

@@ -72,3 +72,16 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
             return q40_matmul_partitioned(x, w, interpret=_pallas_interpret)
         return q40_matmul_xla(x, w)
     return x @ w
+
+
+def q40_matmul_local(x: jnp.ndarray, w: PackedQ40) -> jnp.ndarray:
+    """y = x @ dequant(w) on ALREADY-LOCAL shard shapes — for use inside
+    shard_map regions, where operands are per-device and the GSPMD
+    custom_partitioning wrapper must not re-partition. Pallas when the local
+    shapes fit, fused XLA dequant otherwise."""
+    if w.packed.ndim == 2 and pallas_kernel_active():
+        from .pallas_q40 import pallas_supports, q40_matmul_pallas
+
+        if _pallas_interpret or pallas_supports(w):
+            return q40_matmul_pallas(x, w, interpret=_pallas_interpret)
+    return q40_matmul_xla(x, w)

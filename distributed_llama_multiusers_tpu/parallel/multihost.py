@@ -180,6 +180,9 @@ class RootControlEngine:
         # (root stuck in the next broadcast, worker stuck in collectives the
         # root never dispatched)
         tokens = list(tokens)
+        if not tokens:
+            # same error the inner engine raises — before zero packets go out
+            raise ValueError("prefill needs at least one token (empty prompt)")
         chunk = self._plane.chunk
         out = None
         for off in range(0, len(tokens), chunk):

@@ -12,6 +12,8 @@ Re-design of src/tokenizer.cpp:42-380. Same observable behavior:
 
 from __future__ import annotations
 
+import heapq
+
 from ..formats.tokenizer_file import TokenizerData, load_tokenizer_file
 
 _FFFD = b"\xef\xbf\xbd"
@@ -41,6 +43,13 @@ class Tokenizer:
         self._specials: list[tuple[int, bytes]] = [
             (i, self.vocab[i]) for i in range(self.regular_vocab_size, self.vocab_size)
         ]
+        # first-byte index over specials: the id-order scan only has to touch
+        # candidates that can possibly match at this position (long prompts
+        # otherwise pay n_specials startswith calls per byte)
+        self._specials_by_first: dict[int, list[tuple[int, bytes]]] = {}
+        for tid, piece in self._specials:
+            if piece:
+                self._specials_by_first.setdefault(piece[0], []).append((tid, piece))
         self._decode_pending = b""  # held-back bytes of an incomplete UTF-8 seq
 
     # ---- encode -----------------------------------------------------------
@@ -79,27 +88,62 @@ class Tokenizer:
             # the reference asserts here (src/tokenizer.cpp:337)
             raise ValueError(f"untokenizable trailing bytes: {buf!r}")
 
-        # iterative best-score merge (src/tokenizer.cpp:340-368)
-        while True:
-            best_score = -1e10
-            best_id = -1
-            best_idx = -1
-            for j in range(len(tokens) - 1):
-                a, b = tokens[j], tokens[j + 1]
-                if a >= self.vocab_size or b >= self.vocab_size:
-                    continue
-                merged = self._regular.get(self.vocab[a] + self.vocab[b])
-                if merged is not None and self.scores[merged] > best_score:
-                    best_score = self.scores[merged]
-                    best_id = merged
-                    best_idx = j
-            if best_idx == -1:
-                break
-            tokens[best_idx : best_idx + 2] = [best_id]
-        return tokens
+        return self._merge(tokens)
+
+    def _merge(self, tokens: list[int]) -> list[int]:
+        """Iterative best-score pair merging (src/tokenizer.cpp:340-368), as
+        a heap over candidate pairs instead of the reference's full rescan
+        per merge: O(n log n), not O(n^2), so 100k-char prompts admit without
+        stalling the scheduler thread. Order is identical to the reference —
+        it takes the strictly-best score scanning left to right, i.e. the
+        EARLIEST pair on ties, and merges only remove elements, so original
+        position order equals current order and (-score, left_pos) keys pop
+        in exactly the reference's merge sequence."""
+        n = len(tokens)
+        if n < 2:
+            return tokens
+        ids = list(tokens)
+        nxt = list(range(1, n + 1))  # n = end sentinel
+        prv = list(range(-1, n - 1))
+        alive = [True] * n
+        heap: list[tuple[float, int, int, int, int]] = []
+
+        def push(j: int) -> None:
+            k = nxt[j]
+            if k >= n:
+                return
+            a, b = ids[j], ids[k]
+            if a >= self.vocab_size or b >= self.vocab_size:
+                return
+            merged = self._regular.get(self.vocab[a] + self.vocab[b])
+            # > -1e10: the reference's best-score sentinel never merges
+            # pairs at or below it (src/tokenizer.cpp:342)
+            if merged is not None and self.scores[merged] > -1e10:
+                heapq.heappush(heap, (-self.scores[merged], j, merged, a, b))
+
+        for j in range(n - 1):
+            push(j)
+        while heap:
+            _, j, merged, a, b = heapq.heappop(heap)
+            k = nxt[j]
+            # stale entry: one side merged away or re-merged since the push
+            if not alive[j] or k >= n or ids[j] != a or ids[k] != b:
+                continue
+            ids[j] = merged
+            alive[k] = False
+            nxt[j] = nxt[k]
+            if nxt[k] < n:
+                prv[nxt[k]] = j
+            if prv[j] >= 0:
+                push(prv[j])
+            push(j)
+        return [ids[j] for j in range(n) if alive[j]]
 
     def _find_special_at(self, text: bytes, pos: int) -> int | None:
-        for tid, piece in self._specials:
+        # candidates share the first byte; kept in id order so the first
+        # prefix match is the same one the reference's scan picks
+        # (src/tokenizer.cpp:186-194)
+        for tid, piece in self._specials_by_first.get(text[pos], ()):
             if text.startswith(piece, pos):
                 return tid
         return None

@@ -157,3 +157,107 @@ def test_moe_random_quantize_roundtrip():
     got, _ = llama_forward(config, jax.tree.map(jnp.asarray, q), tokens, positions, init_kv_cache(config, 1))
     # Q40 noise only
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.5)
+
+
+def test_moe_ep2_packed_stays_dequant_in_matmul(moe_model, monkeypatch):
+    """ep>1 + PackedQ40 + live kernel must keep experts quantized in HBM
+    (shard_map expert-parallel path) — never unpack_q40 to dense planes
+    (round-3 Weak #4). Parity vs the dense-weight single-device forward."""
+    import distributed_llama_multiusers_tpu.quants.packed as packed_mod
+    from distributed_llama_multiusers_tpu.ops import linear
+
+    path, _ = moe_model
+    h = load_model_header(path)
+    config, dense_params = load_params_from_m(path, h, dtype=jnp.float32)
+    _, qparams = load_params_from_m_quantized(path, h, dtype=jnp.float32)
+    mesh = make_mesh(MeshPlan(tp=2, ep=2))
+    q_sh = shard_params(qparams, mesh)
+
+    tokens = jnp.asarray([[5, 9, 21, 3]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    ref, _ = llama_forward(config, dense_params, tokens, positions, init_kv_cache(config, 1))
+
+    def boom(*a, **k):
+        raise AssertionError("unpack_q40 called: expert weights dequantized to HBM on the ep path")
+
+    monkeypatch.setattr(packed_mod, "unpack_q40", boom)
+    linear.set_pallas_interpret(True)
+    try:
+        got, _ = llama_forward(
+            config, q_sh, tokens, positions, init_kv_cache(config, 1), mesh=mesh
+        )
+    finally:
+        linear.set_pallas_interpret(False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-3, rtol=2e-3)
+
+
+def test_moe_sparse_dispatch_rows_scale_with_k():
+    """The sparse dispatch feeds the expert matmuls exactly B*T*k rows —
+    per-token FFN work scales with k (n_active), not E (round-3 Weak #4):
+    the jaxpr's three grouped matmuls (ragged_dot: gate/up/down) each take
+    an lhs of N*k rows whatever E is."""
+    from distributed_llama_multiusers_tpu.models.llama import LlamaLayerParams, _moe_ffn
+    from distributed_llama_multiusers_tpu.ops.activations import silu
+
+    E, d, h, N = 8, 64, 128, 256
+    rng = np.random.default_rng(0)
+    lp = LlamaLayerParams(
+        wq=None, wk=None, wv=None, wo=None,
+        w1=jnp.asarray(rng.standard_normal((E, d, h), dtype=np.float32)),
+        w2=jnp.asarray(rng.standard_normal((E, h, d), dtype=np.float32)),
+        w3=jnp.asarray(rng.standard_normal((E, d, h), dtype=np.float32)),
+        rms_att=None, rms_ffn=None,
+        moe_gate=jnp.asarray(rng.standard_normal((d, E), dtype=np.float32)),
+    )
+    y = jnp.asarray(rng.standard_normal((1, N, d), dtype=np.float32))
+
+    def ragged_lhs_rows(k):
+        jaxpr = jax.make_jaxpr(lambda y: _moe_ffn(y, y, lp, silu, k, lambda v: v))(y)
+        rows = []
+
+        def walk(jx):
+            for eqn in jx.eqns:
+                if eqn.primitive.name.startswith("ragged_dot"):
+                    rows.append(eqn.invars[0].aval.shape[0])
+                for v in eqn.params.values():
+                    if hasattr(v, "jaxpr"):
+                        walk(v.jaxpr)
+
+        walk(jaxpr.jaxpr)
+        return rows
+
+    assert ragged_lhs_rows(1) == [N, N, N]
+    assert ragged_lhs_rows(2) == [2 * N, 2 * N, 2 * N]
+
+
+def test_moe_sparse_matches_dense_dispatch():
+    """The grouped sparse dispatch is numerically the same mixture as the
+    dense all-experts einsum (selection via zero routing weights)."""
+    from distributed_llama_multiusers_tpu.models.llama import (
+        LlamaLayerParams,
+        _moe_ffn,
+        _moe_router_weights,
+    )
+    from distributed_llama_multiusers_tpu.ops.activations import silu
+
+    E, d, h, N, k = 4, 64, 128, 33, 2
+    rng = np.random.default_rng(1)
+    w1 = jnp.asarray(rng.standard_normal((E, d, h), dtype=np.float32) * 0.1)
+    w2 = jnp.asarray(rng.standard_normal((E, h, d), dtype=np.float32) * 0.1)
+    w3 = jnp.asarray(rng.standard_normal((E, d, h), dtype=np.float32) * 0.1)
+    gate = jnp.asarray(rng.standard_normal((d, E), dtype=np.float32))
+    lp = LlamaLayerParams(
+        wq=None, wk=None, wv=None, wo=None, w1=w1, w2=w2, w3=w3,
+        rms_att=None, rms_ffn=None, moe_gate=gate,
+    )
+    y = jnp.asarray(rng.standard_normal((2, N, d), dtype=np.float32))
+
+    sparse = _moe_ffn(y, y, lp, silu, k, lambda v: v)
+
+    rw = _moe_router_weights(y, gate, k)
+    g = silu(jnp.einsum("btd,edh->bteh", y, w1))
+    u = jnp.einsum("btd,edh->bteh", y, w3)
+    dd = jnp.einsum("bteh,ehd->bted", g * u, w2)
+    dense = jnp.einsum("bted,bte->btd", dd, rw)
+
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense), atol=1e-4, rtol=1e-4)

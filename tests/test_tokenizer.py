@@ -302,3 +302,59 @@ def test_sampler_xorshift_parity():
         s ^= s >> 27
         expect.append(((s * 0x2545F4914F6CDD1D) & M) >> 32)
     assert vals == expect
+
+
+# ---- heap merge: order parity with the reference's rescan + latency bound --
+
+
+def _merge_reference(tok: Tokenizer, tokens: list[int]) -> list[int]:
+    """The reference's O(n^2) merge verbatim (src/tokenizer.cpp:340-368):
+    full rescan per merge, strictly-best score, earliest pair on ties."""
+    tokens = list(tokens)
+    while True:
+        best_score, best_id, best_idx = -1e10, -1, -1
+        for j in range(len(tokens) - 1):
+            a, b = tokens[j], tokens[j + 1]
+            if a >= tok.vocab_size or b >= tok.vocab_size:
+                continue
+            merged = tok._regular.get(tok.vocab[a] + tok.vocab[b])
+            if merged is not None and tok.scores[merged] > best_score:
+                best_score, best_id, best_idx = tok.scores[merged], merged, j
+        if best_idx == -1:
+            break
+        tokens[best_idx : best_idx + 2] = [best_id]
+    return tokens
+
+
+def test_heap_merge_matches_reference_rescan(tok):
+    import random
+
+    rng = random.Random(0)
+    corpus = "hello world wo rl d helhello   worldworld hel lo "
+    for trial in range(50):
+        n = rng.randint(0, 60)
+        text = "".join(rng.choice(corpus) for _ in range(n))
+        seed = []
+        buf = b""
+        for byte in text.encode():
+            buf += bytes([byte])
+            tid = tok._regular.get(buf)
+            if tid is not None:
+                seed.append(tid)
+                buf = b""
+        assert not buf
+        assert tok._merge(seed) == _merge_reference(tok, seed), (trial, text)
+
+
+def test_long_prompt_encode_is_fast(tok):
+    """100k-char admission must not stall the scheduler thread (VERDICT
+    round-3 Weak #7): the heap merge is O(n log n), so a generous wall
+    bound catches any regression back to quadratic (which takes minutes)."""
+    import time
+
+    text = "hello world " * 8500  # ~100k chars
+    t0 = time.perf_counter()
+    ids = tok.encode(text, add_bos=False, add_special_tokens=True)
+    elapsed = time.perf_counter() - t0
+    assert "".join(tok.vocab[i].decode() for i in ids) == text
+    assert elapsed < 5.0, f"100k-char encode took {elapsed:.1f}s"
