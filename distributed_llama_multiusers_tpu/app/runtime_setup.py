@@ -105,7 +105,19 @@ def load_stack(args, n_lanes: int | None = None):
     from ..quants.codec import FloatType
 
     emulate_q80 = args.buffer_float_type == FloatType.Q80
-    if emulate_q80:
+    q80_sync = False
+    if emulate_q80 and mesh is not None and plan is not None and plan.tp > 1:
+        # same predicate llama_forward uses, so the log only claims the
+        # transport when it will actually engage
+        from ..parallel.collectives import q80_sync_supported
+
+        q80_sync = q80_sync_supported(config.dim, plan.tp) and (
+            config.n_experts > 0 or q80_sync_supported(config.hidden_dim, plan.tp)
+        )
+    if q80_sync:
+        log("🔶", "Q80 sync transport: wo/w2 TP boundaries ship int8+scales "
+                  "(--buffer-float-type q80 on a tp mesh)")
+    elif emulate_q80:
         log("🔶", "Q80 activation-cast emulation enabled (--buffer-float-type q80)")
     if n_proc > 1 and mesh is None:
         print(
@@ -126,6 +138,7 @@ def load_stack(args, n_lanes: int | None = None):
             getattr(args, "kv_dtype", "auto") or "auto"
         ],
         emulate_q80_activations=emulate_q80,
+        q80_sync=q80_sync,
         mesh=mesh,
         replicate_outputs=n_proc > 1,
     )

@@ -204,10 +204,18 @@ def _moe_ffn(y, yq, lp, act_fn, n_active: int, maybe_qdq, ep_sharded: bool = Fal
 
     w1, w2, w3 = lp.w1, lp.w2, lp.w3
     if isinstance(w1, PackedQ40):
-        # the ep shard_map path needs the mesh handle; callers that can't
-        # provide one (pipeline stages run under vmap, where shard_map does
-        # not nest) fall through to the unpack + einsum dispatch below
-        if pallas_kernel_active() and (not ep_sharded or mesh is not None):
+        # the ep shard_map path needs the mesh handle (pipeline stages run
+        # under vmap, where shard_map does not nest) and whole-block tp
+        # shards: hidden % (32*tp) covers the w2 plane sharding AND the
+        # per-shard Q80 qdq blocks; otherwise fall through to unpack+einsum
+        def _ep_path_ok():
+            if mesh is None:
+                return False
+            tp = mesh.shape.get("tp", 1)
+            hidden = w1.packed.shape[-1]
+            return tp == 1 or hidden % (32 * tp) == 0
+
+        if pallas_kernel_active() and (not ep_sharded or _ep_path_ok()):
             rw = _moe_router_weights(y, lp.moe_gate, n_active)
             if ep_sharded:
                 return _moe_ffn_ep_packed(
@@ -252,6 +260,7 @@ def llama_forward(
     cache: KVCache,
     emulate_q80_activations: bool = False,
     mesh=None,
+    q80_sync: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Returns (logits [B, T, vocab] float32, updated cache).
 
@@ -262,6 +271,12 @@ def llama_forward(
     parallel over the S-sharded cache via flash-stats psum
     (parallel/ring_attention.sp_attention) instead of relying on GSPMD to
     partition the dense-scores einsum.
+
+    ``q80_sync`` (with a tp>1 mesh): the wo/w2 row-parallel outputs cross
+    the mesh as Q80 (int8 + f16 block scales) instead of f32 — the
+    reference's default transport (--buffer-float-type q80, ZQ pipe
+    src/llm.cpp:150) realized as psum_scatter + quantized all_gather
+    (parallel/collectives.q80_sync_matmul).
     """
     b, t = tokens.shape
     h_cfg = config
@@ -271,6 +286,19 @@ def llama_forward(
 
     maybe_qdq = _qdq_q80 if emulate_q80_activations else (lambda y: y)
     use_sp = _use_sp(mesh, b)
+    # q80 wire sync needs whole Q80 blocks per tp shard of BOTH synced
+    # output dims (wo -> dim, w2 -> dim with hidden-sharded planes); the
+    # same predicate decides the runtime_setup log, so what is announced is
+    # what runs
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if q80_sync and tp > 1:
+        from ..parallel.collectives import q80_sync_matmul, q80_sync_supported
+
+        use_q80_sync = q80_sync_supported(h_cfg.dim, tp) and (
+            h_cfg.n_experts > 0 or q80_sync_supported(h_cfg.hidden_dim, tp)
+        )
+    else:
+        use_q80_sync = False
 
     x = params.embedding[tokens]  # [B, T, dim]
     lane_idx = jnp.arange(b)[:, None]  # [B, 1]
@@ -311,8 +339,13 @@ def llama_forward(
             )
         attn = attn.reshape(b, t, n_heads * hd).astype(dtype)
 
-        out = matmul(maybe_qdq(attn), lp.wo)
-        x = x + maybe_qdq(out)  # sync-boundary cast (ZQ pipe) + merge_add
+        if use_q80_sync:
+            # the sync-boundary quantization happens ON the wire (the gather
+            # half ships int8+scales), replacing the output-side qdq cast
+            x = x + q80_sync_matmul(maybe_qdq(attn), lp.wo, mesh)
+        else:
+            out = matmul(maybe_qdq(attn), lp.wo)
+            x = x + maybe_qdq(out)  # sync-boundary cast (ZQ pipe) + merge_add
 
         y = rms_norm(x, lp.rms_ffn, eps)
         yq = maybe_qdq(y)
@@ -322,11 +355,16 @@ def llama_forward(
                 ep_sharded=mesh is not None and mesh.shape.get("ep", 1) > 1,
                 mesh=mesh,
             )
+            x = x + maybe_qdq(d)
+        elif use_q80_sync:
+            g = act_fn(matmul(yq, lp.w1))
+            u = matmul(yq, lp.w3)
+            x = x + q80_sync_matmul(maybe_qdq(g * u), lp.w2, mesh)
         else:
             g = act_fn(matmul(yq, lp.w1))
             u = matmul(yq, lp.w3)
             d = matmul(maybe_qdq(g * u), lp.w2)
-        x = x + maybe_qdq(d)
+            x = x + maybe_qdq(d)
 
         return x, (k_cache, v_cache)
 

@@ -69,6 +69,7 @@ class InferenceEngine:
         mesh=None,
         replicate_outputs: bool = False,
         device_topk: int = 64,
+        q80_sync: bool = False,
     ):
         self.config = config
         self.params = params
@@ -102,6 +103,9 @@ class InferenceEngine:
 
         cfg = config
         q80 = emulate_q80_activations
+        # Q80-compressed wo/w2 sync (the reference's default transport);
+        # meaningful on DCN-spanning meshes where payload bytes matter
+        q80s = q80_sync
 
         sp_mesh = mesh
 
@@ -151,7 +155,7 @@ class InferenceEngine:
             # tokens/positions: [n_lanes] -> [n_lanes, 1]
             logits, cache = llama_forward(
                 cfg, params, tokens[:, None], positions[:, None], cache,
-                emulate_q80_activations=q80, mesh=sp_mesh,
+                emulate_q80_activations=q80, mesh=sp_mesh, q80_sync=q80s,
             )
             step = logits[:, 0, :]
             greedy = jnp.argmax(step, axis=-1).astype(jnp.int32)
@@ -186,6 +190,7 @@ class InferenceEngine:
                 KVCache(k=k_lane, v=v_lane),
                 emulate_q80_activations=q80,
                 mesh=sp_mesh,
+                q80_sync=q80s,
             )
             k = jax.lax.dynamic_update_slice_in_dim(cache.k, lane_cache.k, lane, axis=1)
             v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)

@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Local multi-process pod launcher — the analogue of the reference's
+# examples/n-workers.sh local cluster harness (root + N-1 workers on one
+# machine). Here the "cluster" is a jax.distributed pod: every process runs
+# the same SPMD program over a global tp mesh, the root broadcasts a control
+# packet per engine call and workers replay it (parallel/multihost.py).
+#
+# Usage:
+#   examples/pod-launch.sh                 # 2-process pod, synthetic model
+#   N=4 examples/pod-launch.sh             # 4-process pod
+#   MODEL=llama.m TOK=llama.t examples/pod-launch.sh
+#   MODE=api examples/pod-launch.sh        # root serves HTTP on $API_PORT
+#
+# Runs on CPU (one virtual device per process) so it works from a clean
+# checkout with no TPU; on a real multi-host TPU pod, run the same commands
+# on each host with --coordinator pointing at host 0.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N=${N:-2}
+PORT=${PORT:-$((20000 + RANDOM % 20000))}
+API_PORT=${API_PORT:-8080}
+MODE=${MODE:-inference}
+WORKDIR=${WORKDIR:-/tmp/dllama-pod}
+MODEL=${MODEL:-$WORKDIR/model.m}
+TOK=${TOK:-$WORKDIR/tokenizer.t}
+PROMPT=${PROMPT:-"hello world"}
+
+mkdir -p "$WORKDIR"
+if [ ! -f "$MODEL" ]; then
+  echo "⭕ Writing synthetic model to $MODEL (set MODEL=/path/to/real.m to skip)"
+  python - "$MODEL" "$TOK" <<'PY'
+import sys
+from distributed_llama_multiusers_tpu.formats.synthetic import (
+    tiny_header, write_synthetic_model, write_synthetic_tokenizer,
+)
+h = tiny_header()
+write_synthetic_model(sys.argv[1], h, seed=7)
+write_synthetic_tokenizer(sys.argv[2], vocab_size=h.vocab_size)
+PY
+fi
+
+# each process owns ONE virtual CPU device; the pod supplies N globally
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=1"
+
+COMMON=(--coordinator "127.0.0.1:$PORT" --num-processes "$N"
+        --model "$MODEL" --tokenizer "$TOK" --workers "tp$N")
+
+WORKER_PIDS=()
+cleanup() { kill "${WORKER_PIDS[@]}" 2>/dev/null || true; }
+trap cleanup EXIT
+
+for i in $(seq 1 $((N - 1))); do
+  python -m distributed_llama_multiusers_tpu.app.dllama worker \
+    "${COMMON[@]}" --process-id "$i" &
+  WORKER_PIDS+=($!)
+done
+
+if [ "$MODE" = api ]; then
+  exec python -m distributed_llama_multiusers_tpu.app.dllama_api \
+    "${COMMON[@]}" --process-id 0 --port "$API_PORT"
+else
+  python -m distributed_llama_multiusers_tpu.app.dllama inference \
+    "${COMMON[@]}" --process-id 0 --prompt "$PROMPT" --steps "${STEPS:-16}"
+fi
+
+wait "${WORKER_PIDS[@]}"
+echo "⭕ Pod exited cleanly"
