@@ -106,16 +106,15 @@ def load_stack(args, n_lanes: int | None = None):
 
     emulate_q80 = args.buffer_float_type == FloatType.Q80
     q80_sync = False
-    if emulate_q80 and mesh is not None and plan is not None and plan.tp > 1:
+    if emulate_q80 and mesh is not None:
         # same predicate llama_forward uses, so the log only claims the
         # transport when it will actually engage
-        from ..parallel.collectives import q80_sync_supported
+        from ..parallel.collectives import q80_sync_engages
 
-        q80_sync = q80_sync_supported(config.dim, plan.tp) and (
-            config.n_experts > 0 or q80_sync_supported(config.hidden_dim, plan.tp)
-        )
+        q80_sync = q80_sync_engages(config, dict(mesh.shape))
     if q80_sync:
-        log("🔶", "Q80 sync transport: wo/w2 TP boundaries ship int8+scales "
+        synced = "wo" if config.n_experts > 0 else "wo/w2"
+        log("🔶", f"Q80 sync transport: {synced} TP boundaries ship int8+scales "
                   "(--buffer-float-type q80 on a tp mesh)")
     elif emulate_q80:
         log("🔶", "Q80 activation-cast emulation enabled (--buffer-float-type q80)")

@@ -286,19 +286,12 @@ def llama_forward(
 
     maybe_qdq = _qdq_q80 if emulate_q80_activations else (lambda y: y)
     use_sp = _use_sp(mesh, b)
-    # q80 wire sync needs whole Q80 blocks per tp shard of BOTH synced
-    # output dims (wo -> dim, w2 -> dim with hidden-sharded planes); the
-    # same predicate decides the runtime_setup log, so what is announced is
-    # what runs
-    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
-    if q80_sync and tp > 1:
-        from ..parallel.collectives import q80_sync_matmul, q80_sync_supported
+    use_q80_sync = False
+    if q80_sync and mesh is not None:
+        from ..parallel.collectives import q80_sync_engages, q80_sync_matmul
 
-        use_q80_sync = q80_sync_supported(h_cfg.dim, tp) and (
-            h_cfg.n_experts > 0 or q80_sync_supported(h_cfg.hidden_dim, tp)
-        )
-    else:
-        use_q80_sync = False
+        # shared predicate with the runtime_setup startup log
+        use_q80_sync = q80_sync_engages(h_cfg, dict(mesh.shape))
 
     x = params.embedding[tokens]  # [B, T, dim]
     lane_idx = jnp.arange(b)[:, None]  # [B, 1]

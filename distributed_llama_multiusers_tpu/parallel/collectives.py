@@ -39,6 +39,28 @@ def q80_sync_supported(dim: int, tp: int) -> bool:
     return tp > 1 and dim % (Q80_BLOCK * tp) == 0
 
 
+def q80_sync_engages(config, mesh_shape: dict) -> bool:
+    """Single source of truth for whether the Q80 sync transport engages —
+    used by both llama_forward (the compiled program) and the CLI startup
+    log, so what is announced is what runs. Requires:
+
+    - a PURE-TP mesh: the sync shard_map replicates its activations over
+      every non-tp axis, so dp/sp/ep/pp > 1 would add per-layer gathers
+      costing more than the f32 all-reduce saves (the reference's mesh is
+      pure TP too, src/app.cpp:237-240);
+    - whole Q80 blocks per tp shard of every synced output (wo -> dim;
+      the dense-FFN w2 additionally needs hidden-sharded planes; MoE FFNs
+      never route w2 through the wire sync)."""
+    tp = mesh_shape.get("tp", 1)
+    if tp <= 1:
+        return False
+    if any(mesh_shape.get(ax, 1) > 1 for ax in ("dp", "sp", "ep", "pp")):
+        return False
+    return q80_sync_supported(config.dim, tp) and (
+        config.n_experts > 0 or q80_sync_supported(config.hidden_dim, tp)
+    )
+
+
 def q80_all_gather(x: jnp.ndarray, mesh: Mesh, axis: str = "tp") -> jnp.ndarray:
     """All-gather x's last dim across ``axis``, shipping int8+fp16 scales.
 

@@ -138,6 +138,7 @@ class ContinuousBatchingScheduler:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
+        self._stop.clear()  # restartable: a stop()ed scheduler can start again
         self._thread = threading.Thread(target=self._run, name="batching-loop", daemon=True)
         self._thread.start()
 

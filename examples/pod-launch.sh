@@ -58,7 +58,9 @@ for i in $(seq 1 $((N - 1))); do
 done
 
 if [ "$MODE" = api ]; then
-  exec python -m distributed_llama_multiusers_tpu.app.dllama_api \
+  # no exec: the EXIT trap must survive to reap the workers when the
+  # server exits or is killed
+  python -m distributed_llama_multiusers_tpu.app.dllama_api \
     "${COMMON[@]}" --process-id 0 --port "$API_PORT"
 else
   python -m distributed_llama_multiusers_tpu.app.dllama inference \
