@@ -329,6 +329,14 @@ def _phase_ablations(config, small):
         )
     finally:
         linear.set_pallas_enabled(True)
+    # bf16 dequantized-weight tiles in VMEM (precision trade, perf probe)
+    linear.set_pallas_w_dtype(jnp.bfloat16)
+    try:
+        out["ablation_pallas_bf16w_tok_s"] = round(
+            _bench_decode(config, params_q, n_short, n_long, tag="packed+pallas-bf16w"), 2
+        )
+    finally:
+        linear.set_pallas_w_dtype(None)
     del params_q
     host_dense = params_from_random(config, seed=0, dtype=jnp.bfloat16, to_device=False)
     params_d = jax.tree.map(jax.device_put, host_dense)

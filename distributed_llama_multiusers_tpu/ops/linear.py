@@ -26,6 +26,10 @@ _pallas_enabled = True
 # interpret mode even off-TPU, so CPU meshes exercise kernel + partitioning.
 _pallas_interpret = False
 
+# Dtype of the in-VMEM dequantized weight planes (f32 exact; bf16 halves
+# VMEM traffic at a precision cost — bench ablation knob).
+_pallas_w_dtype = None  # None -> kernel default (f32)
+
 
 def set_pallas_enabled(enabled: bool) -> None:
     global _pallas_enabled
@@ -35,6 +39,12 @@ def set_pallas_enabled(enabled: bool) -> None:
 def set_pallas_interpret(enabled: bool) -> None:
     global _pallas_interpret
     _pallas_interpret = enabled
+
+
+def set_pallas_w_dtype(dtype) -> None:
+    """dtype of dequantized weight tiles in VMEM (None -> exact f32)."""
+    global _pallas_w_dtype
+    _pallas_w_dtype = dtype
 
 
 @lru_cache(maxsize=1)
@@ -69,7 +79,8 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
         if w.packed.ndim == 2 and pallas_kernel_active():
             from .pallas_q40 import q40_matmul_partitioned
 
-            return q40_matmul_partitioned(x, w, interpret=_pallas_interpret)
+            kw = {} if _pallas_w_dtype is None else {"w_dtype": _pallas_w_dtype}
+            return q40_matmul_partitioned(x, w, interpret=_pallas_interpret, **kw)
         return q40_matmul_xla(x, w)
     return x @ w
 
@@ -83,5 +94,6 @@ def q40_matmul_local(x: jnp.ndarray, w: PackedQ40) -> jnp.ndarray:
         from .pallas_q40 import pallas_supports, q40_matmul_pallas
 
         if _pallas_interpret or pallas_supports(w):
-            return q40_matmul_pallas(x, w, interpret=_pallas_interpret)
+            kw = {} if _pallas_w_dtype is None else {"w_dtype": _pallas_w_dtype}
+            return q40_matmul_pallas(x, w, interpret=_pallas_interpret, **kw)
     return q40_matmul_xla(x, w)

@@ -142,9 +142,9 @@ def test_sharded_forward_takes_pallas_path(monkeypatch, tmp_path):
     calls = {"n": 0}
     real_kernel = pq.q40_matmul_pallas
 
-    def counting_kernel(x, w, interpret=False):
+    def counting_kernel(x, w, interpret=False, **kw):
         calls["n"] += 1
-        return real_kernel(x, w, interpret=interpret)
+        return real_kernel(x, w, interpret=interpret, **kw)
 
     monkeypatch.setattr(pq, "q40_matmul_pallas", counting_kernel)
     linear.set_pallas_interpret(True)
@@ -170,3 +170,19 @@ def test_sharded_forward_takes_pallas_path(monkeypatch, tmp_path):
 
     assert calls["n"] > 0, "sharded forward never reached the Pallas kernel"
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-3, rtol=2e-3)
+
+
+def test_pallas_bf16_weight_tiles_close():
+    """w_dtype=bf16 (the VMEM-bandwidth ablation knob) stays within bf16
+    rounding of the exact f32 kernel — reachable via
+    linear.set_pallas_w_dtype and the bench ablation."""
+    rng = np.random.default_rng(3)
+    pw = _pack(rng, 256, 128)
+    x = jnp.asarray(rng.standard_normal((4, 128), dtype=np.float32))
+    exact = q40_matmul_pallas(x, pw, interpret=True)
+    loose = q40_matmul_pallas(x, pw, interpret=True, w_dtype=jnp.bfloat16)
+    # bf16 has 8 mantissa bits: ~0.4% relative error per product
+    np.testing.assert_allclose(
+        np.asarray(loose), np.asarray(exact), rtol=2e-2, atol=2e-2
+    )
+    assert not np.array_equal(np.asarray(loose), np.asarray(exact))
