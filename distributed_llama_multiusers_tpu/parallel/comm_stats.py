@@ -82,12 +82,18 @@ def collective_stats_from_hlo(hlo_text: str) -> dict:
     }
 
 
-def collective_stats_of(jitted_fn, *args, **kwargs) -> dict:
-    """Compile (cached by jax where possible) and analyze a jitted function's
-    collective traffic for the given example arguments."""
-    compiled = jitted_fn.lower(*args, **kwargs).compile()
+def collective_stats_of_compiled(compiled) -> dict:
+    """Analyze an already-compiled executable's collective traffic."""
     try:
         text = compiled.as_text()
     except Exception:  # some backends restrict HLO dumps
         return {"total_bytes": 0, "n_collectives": 0, "error": "hlo unavailable"}
     return collective_stats_from_hlo(text)
+
+
+def collective_stats_of(jitted_fn, *args, **kwargs) -> dict:
+    """Compile and analyze a jitted function's collective traffic for the
+    given example arguments. Callers that want to keep the executable (e.g.
+    to dispatch it) should lower+compile themselves and use
+    ``collective_stats_of_compiled``."""
+    return collective_stats_of_compiled(jitted_fn.lower(*args, **kwargs).compile())

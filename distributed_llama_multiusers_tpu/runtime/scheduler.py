@@ -109,6 +109,17 @@ class _Lane:
     decoder: object = None
     pending: list[int] = field(default_factory=list)  # unprocessed prompt tail
     seed: int = 0
+    host_exact: bool = False  # route this lane through the host Sampler
+
+
+# The fused on-device sampler truncates to the top-`device_topk` logits
+# (engine.py _sample_lane) — exact whenever the nucleus fits in k, which a
+# near-1.0 top-p or a very high temperature can defeat (flat distributions
+# spread mass past any fixed k). Such requests fall back to the bit-exact
+# host Sampler (full-vocab xorshift semantics, one [vocab] f32 transfer per
+# token) instead of silently sampling a truncated distribution.
+HOST_EXACT_TOPP = 0.99
+HOST_EXACT_TEMP = 1.5
 
 
 class ContinuousBatchingScheduler:
@@ -198,6 +209,14 @@ class ContinuousBatchingScheduler:
         lane.seed = (
             req.seed if req.seed is not None else int(time.time() * 1e6)
         ) & 0xFFFFFFFF
+        lane.host_exact = self.host_sampling or (
+            req.temperature > 0.0
+            and (
+                req.topp >= HOST_EXACT_TOPP
+                or req.topp <= 0.0  # both samplers define <=0 as full-vocab
+                or req.temperature >= HOST_EXACT_TEMP
+            )
+        )
         lane.sampler = Sampler(
             self.engine.config.vocab_size, req.temperature, req.topp, lane.seed
         )
@@ -226,7 +245,7 @@ class ContinuousBatchingScheduler:
         try:
             logits, greedy, sampled = self.engine.prefill_chunk(
                 lane_idx, chunk, lane.pos,
-                temp=0.0 if self.host_sampling else req.temperature,
+                temp=0.0 if lane.host_exact else req.temperature,
                 topp=req.topp, seed=lane.seed,
             )
         except Exception as e:
@@ -243,7 +262,7 @@ class ContinuousBatchingScheduler:
         # prompt complete: pick the first generated token
         if req.temperature == 0.0:
             first = int(greedy)
-        elif self.host_sampling:
+        elif lane.host_exact:
             first = lane.sampler.sample(self.engine.all_logits(logits))
         else:
             first = int(sampled)  # sampled inside the compiled prefill step
@@ -311,18 +330,20 @@ class ContinuousBatchingScheduler:
             for i, lane in active:
                 tokens[i] = lane.next_token
                 positions[i] = lane.pos
-                if not self.host_sampling:
+                if not lane.host_exact:
                     temps[i] = lane.request.temperature
                     topps[i] = lane.request.topp
                     seeds[i] = lane.seed
             logits, greedy, sampled = self.engine.decode(
                 tokens, positions, temps, topps, seeds
             )
-            # host sampling: one batched [n_lanes, vocab] transfer (the
-            # bit-exact reference-RNG path); on-device: tokens only
+            # host-exact lanes (global host_sampling mode, or per-request
+            # fallback for near-1.0 top-p / very high temperature where the
+            # device sampler's top-k truncation would distort): one batched
+            # [n_lanes, vocab] transfer; pure on-device batches: tokens only
             logits_np = None
-            if self.host_sampling and any(
-                l.request.temperature > 0 for _, l in active
+            if any(
+                l.host_exact and l.request.temperature > 0 for _, l in active
             ):
                 logits_np = self.engine.all_logits(logits)
 
@@ -353,7 +374,7 @@ class ContinuousBatchingScheduler:
                     continue
                 if req.temperature == 0.0:
                     lane.next_token = int(greedy[i])
-                elif self.host_sampling:
+                elif lane.host_exact:
                     lane.next_token = lane.sampler.sample(logits_np[i])
                 else:
                     lane.next_token = int(sampled[i])

@@ -37,7 +37,15 @@ def parse_chat_messages(body: dict) -> list[ChatMessage]:
 @dataclass
 class InferenceParams:
     """Per-request generation params (dllama-api.cpp parseRequest analogue —
-    but actually honored here, unlike the fork)."""
+    but actually honored here, unlike the fork).
+
+    Sampling semantics: sampled requests run on-device (fused into the
+    compiled decode step) over the top-64 logits — exact whenever the
+    nucleus fits, which is the overwhelmingly common case. Requests with
+    top_p >= 0.99 or temperature >= 1.5 automatically fall back to the
+    bit-exact full-vocab host sampler (reference xorshift semantics,
+    runtime/scheduler.py HOST_EXACT_*), trading one [vocab] f32 transfer
+    per token for distribution exactness."""
 
     max_tokens: int = 128
     temperature: float = 0.0

@@ -243,3 +243,48 @@ def test_collective_stats_hlo_parser():
     assert out["bytes_by_kind"]["all-reduce"] == 4 * 256 * 4 + 8 * 4
     assert out["bytes_by_kind"]["all-gather"] == 2 * (2 * 128 * 2)
     assert out["n_collectives"] == 3
+
+
+def test_high_topp_requests_fall_back_to_host_sampler(tiny_model):
+    """top_p >= 0.99 / temp >= 1.5 defeat the device sampler's top-k
+    truncation, so those requests must route through the bit-exact host
+    Sampler (ADVICE r3: the default divergence needs a guard rail), while
+    ordinary sampled requests stay on-device (no [vocab] transfers)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.models.loader import load_params_from_m
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+    from distributed_llama_multiusers_tpu.tokenizer import Tokenizer
+
+    h = load_model_header(tiny_model["model"])
+    config, params = load_params_from_m(tiny_model["model"], h, dtype=jnp.float32)
+    engine = InferenceEngine(config, params, n_lanes=2)
+    fetches = {"n": 0}
+    real = engine.all_logits
+
+    def counting(logits):
+        fetches["n"] += 1
+        return real(logits)
+
+    engine.all_logits = counting
+    sched = ContinuousBatchingScheduler(engine, Tokenizer(tiny_model["tokenizer"]))
+    sched.start()
+    try:
+        on_device = Request(prompt="hello", max_tokens=4, temperature=0.8, topp=0.9, seed=3)
+        sched.submit(on_device)
+        on_device.future.result(timeout=300)
+        assert fetches["n"] == 0, "ordinary sampled request transferred logits"
+
+        exact = Request(prompt="hello", max_tokens=4, temperature=0.8, topp=1.0, seed=3)
+        sched.submit(exact)
+        exact.future.result(timeout=300)
+    finally:
+        sched.stop()
+    assert exact.error is None and len(exact.generated_tokens) >= 1
+    # every sampled token (first included) came from full-vocab host logits
+    assert fetches["n"] >= len(exact.generated_tokens), fetches
