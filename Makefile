@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test clean
+.PHONY: all native test sanitize clean
 
 all: native
 
@@ -16,5 +16,19 @@ $(NATIVE_SO): $(NATIVE_DIR)/quant_codec.cpp
 test: native
 	python -m pytest tests/ -x -q
 
+# ASan+UBSan gate for the native codec (the reference's sanitizer-CI
+# analogue, SURVEY.md §5.2): rebuilds the .so instrumented and reruns the
+# native test suite against it. detect_leaks=0: CPython itself "leaks".
+# The hard load assert matters: tests/test_native.py SKIPS when the library
+# won't load, so without it a broken sanitized build would pass green.
+# Path comes from the module (single source of truth, like the build line).
+NATIVE_SAN_SO = $$(python -c "from distributed_llama_multiusers_tpu.native import _SO_SAN_PATH; print(_SO_SAN_PATH)")
+sanitize:
+	python -c "from distributed_llama_multiusers_tpu.native import ensure_built; import sys; sys.exit(0 if ensure_built(quiet=False, sanitize=True) else 1)"
+	ASAN_OPTIONS=detect_leaks=0:detect_odr_violation=0 \
+	LD_PRELOAD=$$(gcc -print-file-name=libasan.so) \
+	DLLAMA_NATIVE_SO=$(NATIVE_SAN_SO) \
+	sh -c 'python -c "from distributed_llama_multiusers_tpu.native import load; assert load() is not None, \"sanitized .so failed to load\"" && python -m pytest tests/test_native.py -q'
+
 clean:
-	rm -f $(NATIVE_SO)
+	rm -f $(NATIVE_SO) $(NATIVE_SAN_SO)

@@ -25,26 +25,38 @@ _lib: ctypes.CDLL | None = None
 _load_failed = False
 
 
-# single source of truth for the build line; the Makefile target shells out
-# to this module so the two paths cannot drift
+# single source of truth for the build lines; the Makefile targets shell out
+# to this module so the paths cannot drift
 BUILD_FLAGS = ["-O3", "-march=native", "-shared", "-fPIC", "-std=c++17"]
+# ASan+UBSan build (the reference's test strategy leans on sanitizer CI,
+# SURVEY.md §5.2): `make sanitize` builds this variant and runs the native
+# test suite against it with libasan preloaded
+SANITIZE_FLAGS = [
+    "-O1", "-g", "-fno-omit-frame-pointer",
+    "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+    "-shared", "-fPIC", "-std=c++17",
+]
+_SO_SAN_PATH = os.path.join(_DIR, "libdllama_native_asan.so")
 
 
-def ensure_built(quiet: bool = True) -> bool:
+def ensure_built(quiet: bool = True, sanitize: bool = False) -> bool:
     """Compile the shared library if missing/stale (g++). Returns success.
     Compiles to a per-pid temp file then renames, so concurrent first runs
-    cannot corrupt the .so."""
+    cannot corrupt the .so. ``sanitize`` builds the ASan+UBSan variant to
+    its own path (load it via DLLAMA_NATIVE_SO with libasan preloaded)."""
+    so_path = _SO_SAN_PATH if sanitize else _SO_PATH
+    flags = SANITIZE_FLAGS if sanitize else BUILD_FLAGS
     try:
-        if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC):
+        if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(_SRC):
             return True
     except OSError:
         # source missing: usable iff a prebuilt .so is loadable
-        return os.path.exists(_SO_PATH)
-    tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
-    cmd = ["g++", *BUILD_FLAGS, "-o", tmp, _SRC, "-lpthread"]
+        return os.path.exists(so_path)
+    tmp = f"{so_path}.{os.getpid()}.tmp"
+    cmd = ["g++", *flags, "-o", tmp, _SRC, "-lpthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=quiet)
-        os.replace(tmp, _SO_PATH)
+        os.replace(tmp, so_path)
         return True
     except (subprocess.CalledProcessError, FileNotFoundError, OSError):
         try:
@@ -62,11 +74,13 @@ def load() -> ctypes.CDLL | None:
             return _lib
         if _load_failed:
             return None
-        if not ensure_built():
+        # test hook: point at an alternate build (e.g. the sanitized .so)
+        override = os.environ.get("DLLAMA_NATIVE_SO")
+        if not override and not ensure_built():
             _load_failed = True
             return None
         try:
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = ctypes.CDLL(override or _SO_PATH)
         except OSError:
             _load_failed = True
             return None
