@@ -166,7 +166,7 @@ def retile(packed, scales, tile=V2_TILE):
     pt = jnp.moveaxis(packed.reshape(packed.shape[0], j, tile), 1, 0)
     st = jnp.moveaxis(scales.reshape(scales.shape[0], j, tile), 1, 0)
     sbits = jax.lax.bitcast_convert_type(st, jnp.int16)
-    return jnp.ascontiguousarray(pt), jnp.ascontiguousarray(sbits)
+    return jnp.copy(pt), jnp.copy(sbits)
 
 
 def _v2_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref, out_ref,

@@ -1,0 +1,267 @@
+"""Staged Q40 kernel diagnostic: where do the cycles go?
+
+Builds a series of Pallas kernels that incrementally add pipeline stages —
+DMA only, +u8 unpack, +nibble extract, +f32 convert, +scale mul, +MXU dot —
+and times each on the real TPU at decode shapes. The deltas attribute the
+cost. Also times the same stages with the packed plane pre-bitcast to u32
+(4 bytes/lane instead of 1) and an MXU-stream reference with pre-dequantized
+bf16 planes.
+
+Run: python scripts/stage_probe.py [d_in] [d_out] [L]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+from distributed_llama_multiusers_tpu.quants.packed import (  # noqa: E402
+    PackedQ40,
+    pack_q40_host,
+)
+from distributed_llama_multiusers_tpu.ops.pallas_q40 import (  # noqa: E402
+    _f16_bits_to_f32,
+)
+
+HBM_GB_S = 819.0  # v5e
+
+CHUNK = 2048
+TILE = 512
+
+
+# --- u8-plane staged kernels ------------------------------------------------
+
+
+def _k_dma(p_ref, o_ref):
+    # touch one sublane so the block DMA is observable but compute ~ 0
+    o_ref[...] = p_ref[0:1, :].astype(jnp.float32)
+
+
+def _k_unpack(p_ref, o_ref):
+    p = p_ref[...].astype(jnp.int32)
+    o_ref[...] = jnp.sum(p, axis=0, keepdims=True).astype(jnp.float32)
+
+
+def _k_nib(p_ref, o_ref):
+    p = p_ref[...].astype(jnp.int32)
+    lo = p & 0x0F
+    hi = p >> 4
+    o_ref[...] = jnp.sum(lo + hi, axis=0, keepdims=True).astype(jnp.float32)
+
+
+def _k_conv(p_ref, o_ref):
+    p = p_ref[...].astype(jnp.int32)
+    lo = (p & 0x0F).astype(jnp.float32)
+    hi = (p >> 4).astype(jnp.float32)
+    o_ref[...] = jnp.sum(lo + hi, axis=0, keepdims=True)
+
+
+def _k_conv_bf16(p_ref, o_ref):
+    p = p_ref[...].astype(jnp.int32)
+    lo = (p & 0x0F).astype(jnp.bfloat16)
+    hi = (p >> 4).astype(jnp.bfloat16)
+    o_ref[...] = jnp.sum(
+        (lo + hi).astype(jnp.float32), axis=0, keepdims=True
+    )
+
+
+def _k_scale(p_ref, s_ref, o_ref):
+    half_rows, tile = p_ref.shape
+    n_blk = half_rows // 16
+    p = p_ref[...].astype(jnp.int32)
+    s = _f16_bits_to_f32(s_ref[...])[:, None, :]
+    lo = (p & 0x0F).astype(jnp.float32).reshape(n_blk, 16, tile) * s
+    hi = (p >> 4).astype(jnp.float32).reshape(n_blk, 16, tile) * s
+    o_ref[...] = jnp.sum(
+        (lo + hi).reshape(half_rows, tile), axis=0, keepdims=True
+    )
+
+
+def _k_full(x_lo_ref, x_hi_ref, p_ref, s_ref, o_ref, *, w_dtype):
+    half_rows, tile = p_ref.shape
+    n_blk = half_rows // 16
+    p = p_ref[...].astype(jnp.int32)
+    s = _f16_bits_to_f32(s_ref[...])[:, None, :]
+    w_lo = ((p & 0x0F).astype(jnp.float32).reshape(n_blk, 16, tile) * s)
+    w_hi = ((p >> 4).astype(jnp.float32).reshape(n_blk, 16, tile) * s)
+    w_lo = w_lo.reshape(half_rows, tile).astype(w_dtype)
+    w_hi = w_hi.reshape(half_rows, tile).astype(w_dtype)
+    o_ref[...] = (
+        jnp.dot(x_lo_ref[...], w_lo, preferred_element_type=jnp.float32)
+        + jnp.dot(x_hi_ref[...], w_hi, preferred_element_type=jnp.float32)
+    )
+
+
+# --- u32-plane staged kernels (packed bytes pre-bitcast to u32 lanes) -------
+
+
+def _k32_dma(p_ref, o_ref):
+    o_ref[...] = p_ref[0:1, :].astype(jnp.float32)
+
+
+def _k32_unpack(p_ref, o_ref):
+    w = p_ref[...]  # already int32 lanes
+    o_ref[...] = jnp.sum(w, axis=0, keepdims=True).astype(jnp.float32)
+
+
+def _k32_nib(p_ref, o_ref):
+    w = p_ref[...]
+    acc = None
+    for sh in range(0, 32, 4):
+        nib = (w >> sh) & 0x0F
+        acc = nib if acc is None else acc + nib
+    o_ref[...] = jnp.sum(acc, axis=0, keepdims=True).astype(jnp.float32)
+
+
+def _k32_conv(p_ref, o_ref):
+    w = p_ref[...]
+    acc = None
+    for sh in range(0, 32, 4):
+        nib = ((w >> sh) & 0x0F).astype(jnp.float32)
+        acc = nib if acc is None else acc + nib
+    o_ref[...] = jnp.sum(acc, axis=0, keepdims=True)
+
+
+# --- MXU stream reference: pre-dequantized planes, dot only ------------------
+
+
+def _k_dot_only(x_ref, w_ref, o_ref):
+    o_ref[...] = jnp.dot(
+        x_ref[...], w_ref[...], preferred_element_type=jnp.float32
+    )
+
+
+def run_staged(name, kernel, operands, specs, grid, out_shape, bytes_per_pass,
+               reps=30):
+    out_specs, scratch = out_shape
+
+    @jax.jit
+    def once(*ops):
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=specs,
+            out_specs=out_specs,
+            out_shape=scratch,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary"),
+            ),
+        )(*ops)
+
+    @jax.jit
+    def loop(*ops):
+        def body(_, acc):
+            return acc + once(*ops)[0, 0].astype(jnp.float32)
+
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
+
+    try:
+        np.asarray(loop(*operands))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(loop(*operands))
+            best = min(best, time.perf_counter() - t0)
+        sec = best / reps
+        gbs = bytes_per_pass / sec / 1e9
+        print(f"{name:22s} {sec * 1e3:8.3f} ms  {gbs:7.1f} GB/s "
+              f"({gbs / HBM_GB_S * 100:5.1f}% HBM)", flush=True)
+    except Exception as e:
+        print(f"{name:22s} FAILED: {type(e).__name__}: {str(e)[:140]}",
+              flush=True)
+
+
+def main():
+    d_in = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    d_out = int(sys.argv[2]) if len(sys.argv) > 2 else 14336
+    rng = np.random.default_rng(0)
+    w = (rng.standard_normal((d_out, d_in), dtype=np.float32) * 0.05)
+    packed, scales = pack_q40_host(w)
+    packed = jnp.asarray(packed)  # [d_in//2, d_out]
+    scales = jnp.asarray(scales)
+    sbits = jax.lax.bitcast_convert_type(scales, jnp.int16)
+    pbytes = packed.size
+    print(f"d_in={d_in} d_out={d_out} packed={pbytes / 1e6:.1f} MB "
+          f"device={jax.devices()[0].device_kind}", flush=True)
+
+    half = d_in // 2
+    grid = (d_out // TILE, half // (CHUNK // 2))
+    p_spec = pl.BlockSpec((CHUNK // 2, TILE), lambda j, k: (k, j))
+    s_spec = pl.BlockSpec((CHUNK // 32, TILE), lambda j, k: (k, j))
+    o_spec = pl.BlockSpec((1, TILE), lambda j, k: (0, j))
+    o_shape = jax.ShapeDtypeStruct((1, d_out), jnp.float32)
+
+    run_staged("u8 dma", _k_dma, (packed,), [p_spec], grid,
+               (o_spec, o_shape), pbytes)
+    run_staged("u8 +unpack_i32", _k_unpack, (packed,), [p_spec], grid,
+               (o_spec, o_shape), pbytes)
+    run_staged("u8 +nibbles", _k_nib, (packed,), [p_spec], grid,
+               (o_spec, o_shape), pbytes)
+    run_staged("u8 +convert_f32", _k_conv, (packed,), [p_spec], grid,
+               (o_spec, o_shape), pbytes)
+    run_staged("u8 +convert_bf16", _k_conv_bf16, (packed,), [p_spec], grid,
+               (o_spec, o_shape), pbytes)
+    run_staged("u8 +scale", _k_scale, (packed, sbits), [p_spec, s_spec], grid,
+               (o_spec, o_shape), pbytes)
+
+    # u32 lanes: [half, d_out] u8 -> [half, d_out//4] u32 (4 consecutive
+    # d_out columns per lane)
+    p32 = jax.lax.bitcast_convert_type(
+        packed.reshape(half, d_out // 4, 4), jnp.uint32
+    ).astype(jnp.int32)
+    grid32 = (d_out // 4 // (TILE // 4), half // (CHUNK // 2))
+    p32_spec = pl.BlockSpec((CHUNK // 2, TILE // 4), lambda j, k: (k, j))
+    o32_spec = pl.BlockSpec((1, TILE // 4), lambda j, k: (0, j))
+    o32_shape = jax.ShapeDtypeStruct((1, d_out // 4), jnp.float32)
+
+    run_staged("u32 dma", _k32_dma, (p32,), [p32_spec], grid32,
+               (o32_spec, o32_shape), pbytes)
+    run_staged("u32 +unpack", _k32_unpack, (p32,), [p32_spec], grid32,
+               (o32_spec, o32_shape), pbytes)
+    run_staged("u32 +nibbles", _k32_nib, (p32,), [p32_spec], grid32,
+               (o32_spec, o32_shape), pbytes)
+    run_staged("u32 +convert_f32", _k32_conv, (p32,), [p32_spec], grid32,
+               (o32_spec, o32_shape), pbytes)
+
+    # MXU stream reference at same logical shapes: bf16 / f32 dense planes
+    m_pad = 8
+    x = jnp.asarray(rng.standard_normal((m_pad, d_in), dtype=np.float32))
+    for dt, tag in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
+        wd = jnp.asarray(np.swapaxes(w, 0, 1), dtype=dt)  # [d_in, d_out]
+        x_spec = pl.BlockSpec((m_pad, CHUNK), lambda j, k: (0, k))
+        w_spec = pl.BlockSpec((CHUNK, TILE), lambda j, k: (k, j))
+        od_spec = pl.BlockSpec((m_pad, TILE), lambda j, k: (0, j))
+        od_shape = jax.ShapeDtypeStruct((m_pad, d_out), jnp.float32)
+        run_staged(
+            f"dot_only {tag}", _k_dot_only, (x.astype(dt), wd),
+            [x_spec, w_spec], (d_out // TILE, d_in // CHUNK),
+            (od_spec, od_shape), wd.size * wd.dtype.itemsize,
+        )
+
+    # full kernel (current product formulation) at m=8 for reference
+    xf = jnp.asarray(rng.standard_normal((m_pad, d_in), dtype=np.float32))
+    xb = xf.reshape(m_pad, d_in // 32, 2, 16)
+    x_lo = xb[:, :, 0, :].reshape(m_pad, half)
+    x_hi = xb[:, :, 1, :].reshape(m_pad, half)
+    xs = pl.BlockSpec((m_pad, CHUNK // 2), lambda j, k: (0, k))
+    of_spec = pl.BlockSpec((m_pad, TILE), lambda j, k: (0, j))
+    of_shape = jax.ShapeDtypeStruct((m_pad, d_out), jnp.float32)
+    for dt, tag in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
+        run_staged(
+            f"full_nocorr {tag}", partial(_k_full, w_dtype=dt),
+            (x_lo, x_hi, packed, sbits), [xs, xs, p_spec, s_spec], grid,
+            (of_spec, of_shape), pbytes,
+        )
+
+
+if __name__ == "__main__":
+    main()
