@@ -88,12 +88,16 @@ def _random_packed_params(config, seed: int = 0, dtype=None):
     L, d, h = config.n_layers, config.dim, config.hidden_dim
     kv = config.n_kv_heads * config.head_size
 
-    def packed(d_in, d_out, lead=()):
-        return PackedQ40(
-            packed=rng.integers(0, 256, (*lead, d_in // 2, d_out), dtype=np.uint8),
-            scales=(rng.random((*lead, d_in // 32, d_out), dtype=np.float32)
-                    * 0.01 + 0.001).astype(np.float16),
-        )
+    from distributed_llama_multiusers_tpu.quants.packed import pad_packed_d_out
+
+    def packed(d_in, d_out, lead=(), pad=False):
+        pk = rng.integers(0, 256, (*lead, d_in // 2, d_out), dtype=np.uint8)
+        sc = (rng.random((*lead, d_in // 32, d_out), dtype=np.float32)
+              * 0.01 + 0.001).astype(np.float16)
+        if pad:  # wcls only: vocab padding for the slab kernel's wide
+            # tiles, mirroring the loader; llama_forward slices logits back
+            pk, sc = pad_packed_d_out(pk, sc)
+        return PackedQ40(packed=pk, scales=sc)
 
     e = (config.n_experts,) if config.n_experts > 0 else ()
     layers = LlamaLayerParams(
@@ -115,7 +119,7 @@ def _random_packed_params(config, seed: int = 0, dtype=None):
                    * 0.02).astype(dtype),
         layers=layers,
         rms_final=np.ones((d,), np.float32),
-        wcls=packed(d, config.vocab_size),
+        wcls=packed(d, config.vocab_size, pad=True),
         rope_cos=cos,
         rope_sin=sin,
     )
@@ -329,11 +333,13 @@ def _phase_ablations(config, small):
         )
     finally:
         linear.set_pallas_enabled(True)
-    # bf16 dequantized-weight tiles in VMEM (precision trade, perf probe)
-    linear.set_pallas_w_dtype(jnp.bfloat16)
+    # f32 dequantized-weight planes (multi-pass f32 MXU semantics — what the
+    # pre-round-4 "exact" default cost; bf16 planes are now the TPU default
+    # since f32 dot operands round to bf16 MXU passes anyway)
+    linear.set_pallas_w_dtype(jnp.float32)
     try:
-        out["ablation_pallas_bf16w_tok_s"] = round(
-            _bench_decode(config, params_q, n_short, n_long, tag="packed+pallas-bf16w"), 2
+        out["ablation_pallas_f32w_tok_s"] = round(
+            _bench_decode(config, params_q, n_short, n_long, tag="packed+pallas-f32w"), 2
         )
     finally:
         linear.set_pallas_w_dtype(None)

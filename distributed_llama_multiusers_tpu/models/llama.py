@@ -365,7 +365,9 @@ def llama_forward(
 
     y = rms_norm(x, params.rms_final, eps)
     logits = matmul(maybe_qdq(y), params.wcls).astype(jnp.float32)  # [B, T, vocab]
-    return logits, KVCache(k=new_k, v=new_v)
+    # wcls may be padded past vocab_size for the slab kernel's wide tiles
+    # (quants/packed.pad_packed_d_out); identity slice otherwise
+    return logits[..., : h_cfg.vocab_size], KVCache(k=new_k, v=new_v)
 
 
 def llama_forward_train(
@@ -394,7 +396,7 @@ def llama_forward_train(
     )
     x, _ = jax.lax.scan(layer_step, x, params.layers)
     y = rms_norm(x, params.rms_final, eps)
-    return matmul(y, params.wcls).astype(jnp.float32)
+    return matmul(y, params.wcls).astype(jnp.float32)[..., : config.vocab_size]
 
 
 def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None,

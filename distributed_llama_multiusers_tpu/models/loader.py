@@ -23,6 +23,7 @@ from ..quants.packed import (
     PackedQ40,
     pack_q40_from_blocks,
     pack_q40_host,
+    pad_packed_d_out,
 )
 from .config import LlamaConfig
 from .llama import LlamaLayerParams, LlamaParams
@@ -213,7 +214,9 @@ def load_params_from_m_quantized(
         if is_matmul and spec.float_type == FloatType.Q40:
             pk, sc = pack_q40_from_blocks(raw, spec.shape)
             if spec.name == "final_matmul_logits":
-                dense["wcls"] = ("q40", pk, sc)
+                # pad vocab width for the slab kernel's wide tiles; the
+                # model slices logits back to vocab_size (llama_forward)
+                dense["wcls"] = ("q40", *pad_packed_d_out(pk, sc))
             else:
                 key = _TENSOR_NAME_MAP[spec.name]
                 if spec.expert >= 0:
@@ -312,14 +315,16 @@ def quantize_params(params: LlamaParams, to_device: bool = True) -> LlamaParams:
     the caller to place (e.g. with mesh shardings)."""
     up = jnp.asarray if to_device else (lambda x: x)
 
-    def q(w) -> PackedQ40:
+    def q(w, pad: bool = False) -> PackedQ40:
         # w: [L?, d_in, d_out] device/numpy array -> file orientation then pack
         wf = np.swapaxes(np.asarray(w, np.float32), -1, -2)
         pk, sc = pack_q40_host(wf)
+        if pad:  # wcls: widen vocab for the slab kernel (logits re-sliced)
+            pk, sc = pad_packed_d_out(pk, sc)
         return PackedQ40(packed=up(pk), scales=up(sc))
 
     layers = params.layers._replace(**{k: q(getattr(params.layers, k)) for k in _MATMUL_KEYS})
-    return params._replace(layers=layers, wcls=q(params.wcls))
+    return params._replace(layers=layers, wcls=q(params.wcls, pad=True))
 
 
 def params_from_random(

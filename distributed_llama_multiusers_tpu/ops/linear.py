@@ -26,9 +26,12 @@ _pallas_enabled = True
 # interpret mode even off-TPU, so CPU meshes exercise kernel + partitioning.
 _pallas_interpret = False
 
-# Dtype of the in-VMEM dequantized weight planes (f32 exact; bf16 halves
-# VMEM traffic at a precision cost — bench ablation knob).
-_pallas_w_dtype = None  # None -> kernel default (f32)
+# Compute dtype of the Pallas Q40 dot (dequantized weight planes AND the
+# x operand). None -> kernel default: bf16 on TPU (single-pass MXU, the
+# reference's Q80-activation precision class), exact f32 under interpret/
+# CPU tests. Explicit jnp.float32 restores ~f32-accurate multi-pass MXU
+# dots on TPU (the bench ablation knob).
+_pallas_w_dtype = None
 
 
 def set_pallas_enabled(enabled: bool) -> None:
@@ -93,7 +96,9 @@ def q40_matmul_local(x: jnp.ndarray, w: PackedQ40) -> jnp.ndarray:
     if w.packed.ndim == 2 and pallas_kernel_active():
         from .pallas_q40 import pallas_supports, q40_matmul_pallas
 
-        if _pallas_interpret or pallas_supports(w):
+        # pallas_supports gates BOTH modes: interpret runs must not reach
+        # the kernel with shapes the tiling planner rejects
+        if pallas_supports(w):
             kw = {} if _pallas_w_dtype is None else {"w_dtype": _pallas_w_dtype}
             return q40_matmul_pallas(x, w, interpret=_pallas_interpret, **kw)
     return q40_matmul_xla(x, w)

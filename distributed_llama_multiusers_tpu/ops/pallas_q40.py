@@ -20,9 +20,23 @@ nibble offset is folded into one small correction dot against per-block x
 sums instead of a per-weight subtract. Per packed byte the VPU does one
 shift+mask+scale-mul, the rest is MXU work.
 
-Grid: (m tiles, d_out tiles, d_in chunks). The d_in axis is the reduction
-(innermost, "arbitrary"); the output tile accumulates across it in an f32
-VMEM scratch.
+Block layout (round-4 rework, driven by stage_probe.py measurements on a
+real v5e): blocks span the FULL output width (or a wide 512-multiple tile
+for very wide matmuls), so each DMA fetches one contiguous multi-hundred-KB
+slab instead of the 512-BYTE strided rows of the old (chunk, 512) blocks —
+which measured at 47 GB/s of the chip's 819 GB/s on pure reads. Dequant
+happens in 512-lane sub-tiles INSIDE the kernel to bound VMEM transients.
+Grid: (m tiles, d_out wide-tiles, d_in chunks); the d_in axis accumulates
+into an f32 VMEM scratch.
+
+On TPU the dot runs in bf16 by default: BOTH the dequantized weight planes
+and the x operand are cast to bf16 (``w_dtype`` is the dot's compute
+dtype), trading the MXU's multi-pass f32 emulation (~3x slower, ~f32
+accuracy) for single-pass bf16. That rounds activations to 8 mantissa
+bits — the same precision class as the reference's own Q80 activation
+casts (8-bit, src/llm.cpp:232-239). Interpret mode (CPU tests) defaults
+to exact f32; ``set_pallas_w_dtype(jnp.float32)`` restores multi-pass f32
+on TPU.
 """
 
 from __future__ import annotations
@@ -34,11 +48,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..quants.packed import PackedQ40
+from ..quants.packed import (
+    PALLAS_SUB as SUB_TILE,
+    PackedQ40,
+    pallas_sub_tiles as _sub_tiles,
+    pallas_wide_tile as _pick_w,
+)
 
-# Upper bounds; actual tiles are fitted to the operand (see _pick_*).
-DIN_CHUNK = 2048  # input rows per reduction step
-DOUT_TILE = 512
+SINGLE_SLAB_BYTES = 1 << 20  # planes up to this: one DMA, no k axis
+TARGET_BLOCK_BYTES = 1 << 20  # k-chunk size target (DMA/compute overlap)
 M_TILE = 256
 ROW_ALIGN = 8  # x rows padded to this multiple
 
@@ -61,113 +79,153 @@ def _f16_bits_to_f32(h: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(h32 >> 15 != 0, -mag, mag)
 
 
-def _q40_matmul_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
-                       out_ref, acc_ref, *, w_dtype):
-    """One (m tile, d_out tile, d_in chunk) step — the two-dot formulation
-    (round-3 kernel lab "v1", promoted to the product per round-3 VERDICT):
-
-    - NO nibble concat: the low/high nibble planes each feed their own MXU
-      dot against a matching pre-split half of x, so the dequantized tile
-      never needs the [n_blk, 32, tile] relayout the original kernel paid
-      per chunk (the VPU shuffle that capped it at 44% HBM).
-    - NO per-weight -8 subtract: folded into one small correction dot,
-      8 * (per-block x sums) @ scales, subtracted from the accumulator.
-
-    x_lo/x_hi: [mt, chunk/2] (block-interleaved halves of x's columns).
-    bsum_t: [chunk/32, mt] f32 — per-quant-block sums of x, transposed so
-    the (full-extent) lane dim is m. packed: [chunk/2, tile] uint8. scales:
-    [chunk/32, tile] int16 (f16 bits). acc: [mt, tile] f32 scratch.
-    ``w_dtype``: dtype of the dequantized weight planes fed to the MXU —
-    f32 is exact; bf16 halves VMEM traffic but rounds (nibble*scale needs
-    up to 15 mantissa bits).
-    """
-    k = pl.program_id(2)
-
-    p = packed_ref[...].astype(jnp.int32)  # int32: Mosaic lacks i8 arithmetic
-    half_rows, tile = packed_ref.shape
-    n_blk = half_rows // 16
-    s = _f16_bits_to_f32(scales_ref[...])  # [n_blk, tile] f32
-    s3 = s[:, None, :]
-    w_lo = ((p & 0x0F).astype(jnp.float32).reshape(n_blk, 16, tile) * s3)
-    w_hi = ((p >> 4).astype(jnp.float32).reshape(n_blk, 16, tile) * s3)
-    w_lo = w_lo.reshape(half_rows, tile).astype(w_dtype)
-    w_hi = w_hi.reshape(half_rows, tile).astype(w_dtype)
-
-    # folded -8 offset: 8 * bsum_b @ s  == sum_i x_i * 8 * s_block(i)
-    corr = jax.lax.dot_general(
-        bsum_t_ref[...], s, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    partial_sum = (
-        jnp.dot(x_lo_ref[...], w_lo, preferred_element_type=jnp.float32)
-        + jnp.dot(x_hi_ref[...], w_hi, preferred_element_type=jnp.float32)
-        - 8.0 * corr
-    )
-
-    @pl.when(k == 0)
-    def _():
-        acc_ref[...] = partial_sum
-
-    @pl.when(k > 0)
-    def _():
-        acc_ref[...] = acc_ref[...] + partial_sum
-
-    @pl.when(k == pl.num_programs(2) - 1)
-    def _():
-        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+# A packed block (plus Mosaic's double buffer, the dequant transients, and
+# the [m_tile, w_tile] f32 accumulator) must fit VMEM; blocks above this
+# mean the shape has no supported tiling and callers take the XLA fallback.
+MAX_BLOCK_BYTES = 4 << 20
 
 
-def _pick_chunk(d_in: int) -> int | None:
-    """Largest divisor of d_in that is a multiple of 32 and <= DIN_CHUNK
-    (chunks must cover whole quant blocks). None unless d_in is 32-aligned;
-    32 itself always qualifies, so a 32-aligned d_in always gets a chunk."""
-    if d_in % 32 != 0:
-        return None
-    best = 32
-    for c in range(64, min(d_in, DIN_CHUNK) + 1, 32):
-        if d_in % c == 0:
-            best = c
+def _pick_rows(half: int, w: int) -> int | None:
+    """Packed rows per reduction step, or None when no VMEM-safe tiling
+    exists. Small planes: the whole extent (one contiguous DMA). Larger:
+    the biggest 128-multiple divisor of `half` whose slab is
+    ~TARGET_BLOCK_BYTES, so Mosaic double-buffers multi-hundred-KB
+    contiguous fetches."""
+    if half * w <= SINGLE_SLAB_BYTES:
+        return half
+    best = None
+    for rows in range(128, half + 1, 128):
+        if half % rows == 0 and rows * w <= TARGET_BLOCK_BYTES:
+            best = rows
+    if best is None and half * w <= MAX_BLOCK_BYTES:
+        return half  # e.g. half with no 128-multiple divisor, modest plane
     return best
 
 
-def _pick_tile(n: int, cap: int) -> int:
-    for c in range(cap, 127, -128):
-        if n % c == 0:
-            return c
-    return n
+def _plan_blocks(d_in: int, d_out: int) -> tuple[int, int] | None:
+    """(w_tile, rows) for the slab kernel, or None when the shape has no
+    supported VMEM-safe tiling (callers use q40_matmul_xla)."""
+    if d_in % 32 != 0:
+        return None
+    w_tile = _pick_w(d_out)
+    if w_tile is None:
+        return None
+    rows = _pick_rows(d_in // 2, w_tile)
+    if rows is None:
+        return None
+    return w_tile, rows
 
 
-# the dequantized f32 weight tile (chunk x tile) must fit VMEM comfortably
-# alongside x, packed, scales, and the accumulator
-MAX_W_TILE_BYTES = 8 * 1024 * 1024
+def _q40_slab_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
+                     out_ref, acc_ref, *, w_dtype, sub_tiles, n_k):
+    """One (m tile, d_out wide-tile, d_in chunk) step — two-dot formulation
+    over a contiguous weight slab:
+
+    - NO nibble concat: the low/high nibble planes each feed their own MXU
+      dot against a matching pre-split half of x, so the dequantized tile
+      never needs the [n_blk, 32, tile] relayout of the round-1 kernel.
+    - NO per-weight -8 subtract: folded into one small correction dot,
+      8 * (per-block x sums) @ scales, subtracted from the partial sum.
+    - Dequant walks the slab in `sub_tiles`-lane slices to bound the VMEM
+      transient (the slab itself can be megabytes wide).
+
+    x_lo/x_hi: [mt, rows] (block-interleaved halves of x's columns for this
+    d_in chunk). bsum_t: [rows/16, mt] f32 per-quant-block x sums,
+    transposed so the lane dim is m. packed: [rows, W] uint8 slab. scales:
+    [rows/16, W] int16 (f16 bits). acc: [mt, W] f32 scratch (elided when
+    n_k == 1: the block writes out_ref directly)."""
+    rows, _ = packed_ref.shape
+    n_blk = rows // 16
+    k = pl.program_id(2)
+    x_lo = x_lo_ref[...].astype(w_dtype)
+    x_hi = x_hi_ref[...].astype(w_dtype)
+    bsum_t = bsum_t_ref[...]
+
+    off = 0
+    for t in sub_tiles:
+        p = packed_ref[:, off:off + t].astype(jnp.int32)
+        s = _f16_bits_to_f32(scales_ref[:, off:off + t])  # [n_blk, t] f32
+        s3 = s[:, None, :]
+        w_lo = ((p & 0x0F).astype(jnp.float32).reshape(n_blk, 16, t) * s3)
+        w_hi = ((p >> 4).astype(jnp.float32).reshape(n_blk, 16, t) * s3)
+        w_lo = w_lo.reshape(rows, t).astype(w_dtype)
+        w_hi = w_hi.reshape(rows, t).astype(w_dtype)
+
+        # folded -8 offset: 8 * bsum_b @ s == sum_i x_i * 8 * s_block(i)
+        corr = jax.lax.dot_general(
+            bsum_t, s, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        part = (
+            jnp.dot(x_lo, w_lo, preferred_element_type=jnp.float32)
+            + jnp.dot(x_hi, w_hi, preferred_element_type=jnp.float32)
+            - 8.0 * corr
+        )
+
+        if n_k == 1:
+            out_ref[:, off:off + t] = part.astype(out_ref.dtype)
+        else:
+            @pl.when(k == 0)
+            def _(part=part, off=off, t=t):
+                acc_ref[:, off:off + t] = part
+
+            @pl.when(k > 0)
+            def _(part=part, off=off, t=t):
+                acc_ref[:, off:off + t] = acc_ref[:, off:off + t] + part
+        off += t
+
+    if n_k > 1:
+        @pl.when(k == n_k - 1)
+        def _():
+            out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
 def pallas_supports(w: PackedQ40) -> bool:
-    """True when the kernel's fitted block shapes are VMEM-safe; otherwise
-    callers should take the q40_matmul_xla fallback (ops/linear.py)."""
+    """True when the slab kernel handles these shapes; otherwise callers
+    take the q40_matmul_xla fallback (ops/linear.py). d_in must cover whole
+    quant blocks; d_out must give a valid wide tile (the loader pads wcls
+    to a multiple of 8192 so vocab-width matmuls qualify); the fitted
+    blocks must be VMEM-safe."""
     if w.packed.ndim != 2:
         return False
-    chunk = _pick_chunk(w.d_in)
-    if chunk is None:
-        return False
-    tile = _pick_tile(w.d_out, DOUT_TILE)
-    return chunk * tile * 4 <= MAX_W_TILE_BYTES
+    return _plan_blocks(w.d_in, w.d_out) is not None
+
+
+def _resolve_w_dtype(w_dtype, interpret: bool):
+    """None -> exact f32 in interpret mode (CPU parity tests), bf16 on TPU.
+    w_dtype is the dot's COMPUTE dtype: the dequantized planes and the x
+    operand are both cast to it (bf16 = single-pass MXU; f32 = slower
+    multi-pass emulation with ~f32 accuracy)."""
+    if w_dtype is not None:
+        return w_dtype
+    return jnp.float32 if interpret else jnp.bfloat16
 
 
 @partial(jax.jit, static_argnames=("interpret", "w_dtype"))
 def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
-                      w_dtype=jnp.float32) -> jnp.ndarray:
+                      w_dtype=None) -> jnp.ndarray:
     """y = x @ dequant(w). x: [..., d_in]; returns [..., d_out] in x.dtype.
 
-    ``w_dtype``: dtype of the in-VMEM dequantized weight planes (f32 exact —
-    the default; bf16 trades exactness for VMEM bandwidth, bench ablation
-    only)."""
+    ``w_dtype``: the dot's compute dtype — applied to the dequantized
+    weight planes AND the x operand. None (the default) resolves to exact
+    f32 under interpret and bf16 on TPU — see ``_resolve_w_dtype``.
+    Explicit f32 on TPU restores multi-pass f32 MXU semantics (slower,
+    more mantissa); explicit bf16 under interpret is the ablation/test
+    knob."""
     if w.packed.ndim != 2:
         raise ValueError(f"expected 2D packed weight, got {w.packed.shape}")
     d_in, d_out = w.d_in, w.d_out
-    chunk = _pick_chunk(d_in)
-    if chunk is None:
-        raise ValueError(f"d_in={d_in} not 32-divisible; use q40_matmul_xla")
+    half = d_in // 2
+    plan = _plan_blocks(d_in, d_out)
+    if plan is None:
+        raise ValueError(
+            f"shape ({d_in}, {d_out}) unsupported; use q40_matmul_xla"
+        )
+    w_tile, rows = plan
+    sub = _sub_tiles(w_tile)
+    n_k = half // rows
+    w_dtype = _resolve_w_dtype(w_dtype, interpret)
+
     lead = x.shape[:-1]
     m = 1
     for s in lead:
@@ -185,32 +243,34 @@ def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
     # negligible next to the weight read): split x's columns into the
     # block-local nibble halves matching the packed planes, and precompute
     # per-quant-block sums for the folded -8 correction. bsum is kept
-    # TRANSPOSED [n_blk, m] so its (full-extent) lane dim is m — Pallas
-    # lane-dim blocks must be multiples of 128 or the full extent.
+    # TRANSPOSED [n_blk, m] so its lane dim is m — Pallas lane-dim blocks
+    # must be multiples of 128 or the full extent, and m tiles are either
+    # the whole of m_pad or 256-wide.
     n_blk_total = d_in // 32
     xb = xf.reshape(m_pad, n_blk_total, 2, 16)
-    x_lo = xb[:, :, 0, :].reshape(m_pad, d_in // 2)
-    x_hi = xb[:, :, 1, :].reshape(m_pad, d_in // 2)
+    x_lo = xb[:, :, 0, :].reshape(m_pad, half)
+    x_hi = xb[:, :, 1, :].reshape(m_pad, half)
     bsum_t = xf.reshape(m_pad, n_blk_total, 32).sum(axis=2).T
 
-    tile = _pick_tile(d_out, DOUT_TILE)
-    grid = (m_pad // m_tile, d_out // tile, d_in // chunk)
+    grid = (m_pad // m_tile, d_out // w_tile, n_k)
 
     scale_bits = jax.lax.bitcast_convert_type(w.scales, jnp.int16)
 
     out = pl.pallas_call(
-        partial(_q40_matmul_kernel, w_dtype=w_dtype),
+        partial(_q40_slab_kernel, w_dtype=w_dtype, sub_tiles=sub, n_k=n_k),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((m_tile, chunk // 2), lambda i, j, k: (i, k)),
-            pl.BlockSpec((m_tile, chunk // 2), lambda i, j, k: (i, k)),
-            pl.BlockSpec((chunk // 32, m_tile), lambda i, j, k: (k, i)),
-            pl.BlockSpec((chunk // 2, tile), lambda i, j, k: (k, j)),
-            pl.BlockSpec((chunk // 32, tile), lambda i, j, k: (k, j)),
+            pl.BlockSpec((m_tile, rows), lambda i, j, k: (i, k)),
+            pl.BlockSpec((m_tile, rows), lambda i, j, k: (i, k)),
+            pl.BlockSpec((rows // 16, m_tile), lambda i, j, k: (k, i)),
+            pl.BlockSpec((rows, w_tile), lambda i, j, k: (k, j)),
+            pl.BlockSpec((rows // 16, w_tile), lambda i, j, k: (k, j)),
         ],
-        out_specs=pl.BlockSpec((m_tile, tile), lambda i, j, k: (i, j)),
+        out_specs=pl.BlockSpec((m_tile, w_tile), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m_pad, d_out), x.dtype),
-        scratch_shapes=[pltpu.VMEM((m_tile, tile), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((m_tile, w_tile if n_k > 1 else SUB_TILE), jnp.float32)
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -325,7 +385,7 @@ _q40_mm.def_partition(
 
 
 def q40_matmul_partitioned(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
-                           w_dtype=jnp.float32) -> jnp.ndarray:
+                           w_dtype=None) -> jnp.ndarray:
     """y = x @ dequant(w), partitionable under GSPMD meshes (TP/EP serving
     keeps dequant-in-matmul, closing round 1's 'Pallas disabled under any
     mesh' gap). Single device: identical to q40_matmul_pallas with XLA

@@ -116,4 +116,7 @@ def pipeline_forward_train(
 
     x = outs.reshape(b, t, -1)
     y = rms_norm(x, params.rms_final, config.norm_epsilon)
-    return matmul(y, params.wcls).astype(jnp.float32)
+    # wcls may be padded past vocab_size (quants/packed.pad_packed_d_out);
+    # slice like llama_forward_train so the twins stay logit-identical
+    logits = matmul(y, params.wcls).astype(jnp.float32)
+    return logits[..., : config.vocab_size]

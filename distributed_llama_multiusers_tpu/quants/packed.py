@@ -100,6 +100,63 @@ def pack_q40_host(w: np.ndarray):
     return pack_q40_planar(values, scales)
 
 
+# ---------------------------------------------------------------------------
+# Slab-kernel geometry (shared with ops/pallas_q40): the Pallas kernel reads
+# weights in full-width (or wide 512-multiple) contiguous slabs. These are
+# pure-math helpers so the loader can pad without importing Pallas.
+# ---------------------------------------------------------------------------
+
+PALLAS_W_MAX = 8192  # widest output block of the slab kernel
+PALLAS_SUB = 512  # in-kernel dequant sub-tile (lanes)
+
+
+def pallas_sub_tiles(w: int) -> list[int] | None:
+    """Static lane sub-tile sizes for a width-w kernel block: 512-lane
+    tiles plus a 128-multiple remainder (slice offsets stay 128-aligned —
+    e.g. Llama-2-7B's 5504-wide TP shard tiles as 10x512 + 384), a single
+    tile for narrow test shapes, None when unsupported."""
+    if w % 128 == 0:
+        tiles = [PALLAS_SUB] * (w // PALLAS_SUB)
+        if w % PALLAS_SUB:
+            tiles.append(w % PALLAS_SUB)
+        return tiles
+    if w <= 4096:  # odd widths (e.g. 2752 = 11008/4 TP shard): one tile
+        return [w]
+    return None
+
+
+def pallas_wide_tile(d_out: int) -> int | None:
+    """Output-block width the slab kernel would use for this d_out, or None
+    when unsupported (callers fall back to q40_matmul_xla, or pad — see
+    pad_packed_d_out)."""
+    if d_out <= PALLAS_W_MAX and pallas_sub_tiles(d_out) is not None:
+        return d_out
+    for cand in range(PALLAS_W_MAX, 127, -128):
+        if d_out % cand == 0:
+            return cand
+    return None
+
+
+def pad_packed_d_out(packed: np.ndarray, scales: np.ndarray):
+    """Zero-pad a packed weight's OUTPUT dim to a multiple of 8192 when the
+    slab kernel cannot tile it WELL (e.g. vocab 128256: best natural tile
+    is a strided 768 — padding to 131072 buys full 8192-wide contiguous
+    slabs for +2.2% bytes). Only valid for output-only tensors (wcls):
+    consumers must slice the matmul result back to the true width
+    (llama_forward slices logits to vocab_size). Zero scales make the pad
+    columns exact zeros."""
+    d_out = packed.shape[-1]
+    tile = pallas_wide_tile(d_out)
+    if d_out <= PALLAS_W_MAX or (tile is not None and tile >= 4096):
+        return packed, scales
+    pad = -d_out % PALLAS_W_MAX
+    width = [(0, 0)] * (packed.ndim - 1) + [(0, pad)]
+    return (
+        np.pad(np.asarray(packed), width),
+        np.pad(np.asarray(scales), width),
+    )
+
+
 def unpack_q40(w: PackedQ40, dtype=jnp.float32) -> jnp.ndarray:
     """Dequantize to a dense [..., d_in, d_out] array (XLA fallback path;
     the Pallas kernel in ops/pallas_q40.py does this tile-wise in VMEM)."""

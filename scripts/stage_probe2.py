@@ -1,0 +1,205 @@
+"""Slab-layout DMA experiments for the Q40 kernel.
+
+stage_probe.py showed the current kernel is DMA-bound: blocks of
+[chunk/2, 512] u8 over a [half, d_out] plane fetch 512-BYTE strided rows
+and per-grid-step overhead dominates (~10 us/step). This probe measures
+pure-DMA and full-matmul throughput when the packed plane is PRE-TILED to
+[J, half, T] (one output tile = one contiguous slab) across slab sizes,
+plus full-width blocks, to find the layout that saturates HBM.
+
+Run: python scripts/stage_probe2.py [d_in] [d_out] [L]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+from distributed_llama_multiusers_tpu.quants.packed import (  # noqa: E402
+    pack_q40_host,
+)
+from distributed_llama_multiusers_tpu.ops.pallas_q40 import (  # noqa: E402
+    _f16_bits_to_f32,
+)
+
+HBM_GB_S = 819.0
+M = 8
+
+
+def timeit(name, build_call, bytes_per_pass, reps=8):
+    @jax.jit
+    def loop(seed):
+        def body(_, acc):
+            t = jnp.full((1, 128), acc, jnp.float32)
+            out = build_call(t)
+            return out.reshape(-1)[0].astype(jnp.float32) * 1e-30
+
+        return jax.lax.fori_loop(0, reps, body, seed)
+
+    try:
+        np.asarray(loop(jnp.float32(0)))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(loop(jnp.float32(0)))
+            best = min(best, time.perf_counter() - t0)
+        sec = best / reps
+        gbs = bytes_per_pass / sec / 1e9
+        print(f"{name:28s} {sec * 1e3:8.3f} ms  {gbs:7.1f} GB/s "
+              f"({gbs / HBM_GB_S * 100:5.1f}% HBM)", flush=True)
+    except Exception as e:
+        print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:140]}",
+              flush=True)
+
+
+def main():
+    d_in = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    d_out = int(sys.argv[2]) if len(sys.argv) > 2 else 14336
+    L = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    half = d_in // 2
+    n_blk = d_in // 32
+
+    rng = np.random.default_rng(0)
+    w = (rng.standard_normal((d_out, d_in), dtype=np.float32) * 0.05)
+    p, s = pack_q40_host(w)  # [half, d_out], [n_blk, d_out] f16
+    pbytes = L * p.size
+    print(f"d_in={d_in} d_out={d_out} L={L} packed={pbytes / 1e6:.1f} MB "
+          f"device={jax.devices()[0].device_kind}", flush=True)
+
+    t_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
+
+    # ---- slab layouts: [L, J, half, T] --------------------------------------
+    for T in (512, 1024, 2048):
+        J = d_out // T
+        pt = np.moveaxis(p.reshape(half, J, T), 1, 0)  # [J, half, T]
+        slab = jnp.asarray(np.broadcast_to(pt, (L, J, half, T)))
+        st = np.moveaxis(s.reshape(n_blk, J, T), 1, 0)
+        slab_s = jax.lax.bitcast_convert_type(
+            jnp.asarray(np.broadcast_to(st.astype(np.float16), (L, J, n_blk, T))),
+            jnp.int16,
+        )
+        grid = (L, J)
+        p_spec = pl.BlockSpec((1, 1, half, T), lambda l, j: (l, j, 0, 0))
+        s_spec = pl.BlockSpec((1, 1, n_blk, T), lambda l, j: (l, j, 0, 0))
+        o_spec = pl.BlockSpec((1, T), lambda l, j: (0, j))
+        o_shape = jax.ShapeDtypeStruct((1, d_out), jnp.float32)
+        params = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "parallel"),
+        )
+
+        def dma_call(t, slab=slab, grid=grid, p_spec=p_spec, o_spec=o_spec,
+                     o_shape=o_shape, params=params):
+            def kern(t_ref, p_ref, o_ref):
+                o_ref[...] = (
+                    p_ref[0, 0, 0:1, :].astype(jnp.int32).astype(jnp.float32)
+                    + t_ref[0, 0]
+                )
+
+            return pl.pallas_call(
+                kern, grid=grid, in_specs=[t_spec, p_spec],
+                out_specs=o_spec, out_shape=o_shape, compiler_params=params,
+            )(t, slab)
+
+        timeit(f"slab T={T} dma", dma_call, pbytes)
+
+        def scale_call(t, slab=slab, slab_s=slab_s, grid=grid, p_spec=p_spec,
+                       s_spec=s_spec, o_spec=o_spec, o_shape=o_shape,
+                       params=params, T=T):
+            def kern(t_ref, p_ref, s_ref, o_ref):
+                pb = p_ref[0, 0].astype(jnp.int32)
+                sb = _f16_bits_to_f32(s_ref[0, 0])[:, None, :]
+                nb = pb.shape[0] // 16
+                lo = (pb & 0x0F).astype(jnp.float32).reshape(nb, 16, T) * sb
+                hi = (pb >> 4).astype(jnp.float32).reshape(nb, 16, T) * sb
+                o_ref[...] = (
+                    jnp.sum((lo + hi).reshape(nb * 16, T), axis=0,
+                            keepdims=True)
+                    + t_ref[0, 0]
+                )
+
+            return pl.pallas_call(
+                kern, grid=grid, in_specs=[t_spec, p_spec, s_spec],
+                out_specs=o_spec, out_shape=o_shape, compiler_params=params,
+            )(t, slab, slab_s)
+
+        timeit(f"slab T={T} dequant+scale", scale_call, pbytes)
+
+        # full matmul on slab layout: two-dot, f32 planes
+        xf = jnp.asarray(rng.standard_normal((M, d_in), dtype=np.float32))
+        xb = xf.reshape(M, n_blk, 2, 16)
+        x_lo = xb[:, :, 0, :].reshape(M, half)
+        x_hi = xb[:, :, 1, :].reshape(M, half)
+        x_spec = pl.BlockSpec((M, half), lambda l, j: (0, 0))
+        om_spec = pl.BlockSpec((M, T), lambda l, j: (0, j))
+        om_shape = jax.ShapeDtypeStruct((M, d_out), jnp.float32)
+
+        def full_call(t, slab=slab, slab_s=slab_s, x_lo=x_lo, x_hi=x_hi,
+                      grid=grid, p_spec=p_spec, s_spec=s_spec,
+                      x_spec=x_spec, om_spec=om_spec, om_shape=om_shape,
+                      params=params, T=T, w_dt=jnp.float32):
+            def kern(t_ref, xl_ref, xh_ref, p_ref, s_ref, o_ref):
+                pb = p_ref[0, 0].astype(jnp.int32)
+                sb = _f16_bits_to_f32(s_ref[0, 0])[:, None, :]
+                nb = pb.shape[0] // 16
+                w_lo = ((pb & 0x0F).astype(jnp.float32).reshape(nb, 16, T)
+                        * sb).reshape(nb * 16, T).astype(w_dt)
+                w_hi = ((pb >> 4).astype(jnp.float32).reshape(nb, 16, T)
+                        * sb).reshape(nb * 16, T).astype(w_dt)
+                o_ref[...] = (
+                    jnp.dot(xl_ref[...].astype(w_dt), w_lo,
+                            preferred_element_type=jnp.float32)
+                    + jnp.dot(xh_ref[...].astype(w_dt), w_hi,
+                              preferred_element_type=jnp.float32)
+                    + t_ref[0, 0]
+                )
+
+            return pl.pallas_call(
+                kern, grid=grid,
+                in_specs=[t_spec, x_spec, x_spec, p_spec, s_spec],
+                out_specs=om_spec, out_shape=om_shape,
+                compiler_params=params,
+            )(t, x_lo, x_hi, slab, slab_s)
+
+        timeit(f"slab T={T} full f32", full_call, pbytes)
+        timeit(f"slab T={T} full bf16",
+               partial(full_call, w_dt=jnp.bfloat16), pbytes)
+        del slab, slab_s
+
+    # ---- full-width blocks: [L, half, d_out], block rows x full width ------
+    stacked = jnp.asarray(np.broadcast_to(p, (L, half, d_out)))
+    for rows in (256, 512, 1024):
+        grid = (L, half // rows)
+        p_spec = pl.BlockSpec((1, rows, d_out), lambda l, k: (l, k, 0))
+        o_spec = pl.BlockSpec((1, d_out), lambda l, k: (0, 0))
+        o_shape = jax.ShapeDtypeStruct((1, d_out), jnp.float32)
+        params = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        )
+
+        def dma_wide(t, stacked=stacked, grid=grid, p_spec=p_spec,
+                     o_spec=o_spec, o_shape=o_shape, params=params):
+            def kern(t_ref, p_ref, o_ref):
+                o_ref[...] = (
+                    p_ref[0, 0:1, :].astype(jnp.int32).astype(jnp.float32)
+                    + t_ref[0, 0]
+                )
+
+            return pl.pallas_call(
+                kern, grid=grid, in_specs=[t_spec, p_spec],
+                out_specs=o_spec, out_shape=o_shape, compiler_params=params,
+            )(t, stacked)
+
+        timeit(f"wide rows={rows} dma", dma_wide, pbytes)
+
+
+if __name__ == "__main__":
+    main()

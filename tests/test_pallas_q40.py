@@ -47,6 +47,12 @@ def test_f16_bit_conversion_exact():
         # d_in with no power-of-two chunk divisor (1376 = 43*32): the analogue
         # of Llama-2-7B's hidden_dim 11008 that crashed the halves layout
         (3, 1376, 128),
+        # Llama-2-7B hidden_dim itself: d_out > 8192 with no 512-multiple
+        # divisor — the wide-tile planner must fall back to 128-multiples
+        # (5504 = 43*128), not reject the shape
+        (2, 256, 11008),
+        # and its tp=2 shard: d_out <= 8192, 512-multiple + 384 remainder
+        (2, 256, 5504),
     ],
 )
 def test_pallas_matches_xla(m, d_in, d_out):
