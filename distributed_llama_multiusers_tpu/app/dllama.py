@@ -78,6 +78,17 @@ def run_inference(args) -> None:
         total = sum(pred_times)
         log("⏱", f"Evaluation: {eval_s * 1000:.2f} ms ({len(tokens) / eval_s:.2f} tok/s)")
         log("⏱", f"Prediction: {total * 1000:.2f} ms ({len(pred_times) / total:.2f} tok/s)")
+    if args.benchmark and getattr(engine, "mesh", None) is not None:
+        # measured split (profiler trace) next to the static byte estimate —
+        # the reference's per-token Sync ms is a measured wall clock. Pod
+        # roots return {} (RootControlEngine.measured_sync_stats: the probe
+        # would deadlock workers), which the .get below skips.
+        m = engine.measured_sync_stats()
+        if m.get("sync_ms") is not None:
+            log("⏱", f"Measured/step: {m['step_ms']:.2f} ms wall, "
+                f"{m['device_busy_ms']:.2f} ms device, "
+                f"Sync {m['sync_ms']:.2f} ms ({m['sync_frac'] * 100:.1f}% "
+                f"of device, {m['source']})")
     if hasattr(engine, "stop_workers"):
         engine.stop_workers()
 

@@ -97,3 +97,130 @@ def collective_stats_of(jitted_fn, *args, **kwargs) -> dict:
     to dispatch it) should lower+compile themselves and use
     ``collective_stats_of_compiled``."""
     return collective_stats_of_compiled(jitted_fn.lower(*args, **kwargs).compile())
+
+
+# ---------------------------------------------------------------------------
+# MEASURED step/sync breakdown (vs the static byte estimates above).
+#
+# The reference prints measured per-token Sync *time* from wall clocks
+# around its socket syncs (src/nn/nn-executor.cpp:148-157, dllama.cpp:54-64).
+# Under XLA the collectives run inside the compiled program, so the measured
+# equivalent comes from a profiler trace: collect the xplane, sum the op
+# events, and split out those whose names are collective ops. On TPU these
+# live on the /device:* planes; on XLA:CPU (virtual-mesh tests) the thunks
+# emit the same op names as host TraceMes.
+# ---------------------------------------------------------------------------
+
+
+def _parse_xplanes(pb_paths) -> dict | None:
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+    except ImportError:
+        return None
+
+    busy_ps = 0
+    coll_ps = 0
+    coll_by_kind: dict[str, int] = {}
+    saw_device_plane = False
+    for path in pb_paths:
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        for plane in space.planes:
+            is_device = plane.name.startswith("/device:")
+            saw_device_plane |= is_device
+            metas = plane.event_metadata
+            # device planes: use ONE op-level line — "XLA Ops", else the
+            # line with the largest summed duration (lines overlap in wall
+            # time, so summing several would multiply-count busy time).
+            # Host planes: scan every thread for thunk TraceMes.
+            lines = plane.lines
+            if is_device:
+                op_lines = [ln for ln in lines if ln.name == "XLA Ops"]
+                if not op_lines and lines:
+                    op_lines = [max(
+                        lines,
+                        key=lambda ln: sum(e.duration_ps for e in ln.events),
+                    )]
+                lines = op_lines
+            for line in lines:
+                for ev in line.events:
+                    name = metas[ev.metadata_id].name
+                    if is_device:
+                        busy_ps += ev.duration_ps
+                    for kind in _COLLECTIVES:
+                        if name.startswith(kind):
+                            coll_ps += ev.duration_ps
+                            coll_by_kind[kind] = (
+                                coll_by_kind.get(kind, 0) + ev.duration_ps
+                            )
+                            if not is_device:
+                                busy_ps += ev.duration_ps
+                            break
+                    else:
+                        if not is_device and name == "PjRtCpuExecutable::Execute":
+                            busy_ps += ev.duration_ps
+    return {
+        "busy_ps": busy_ps,
+        "collective_ps": coll_ps,
+        "collective_by_kind_ps": coll_by_kind,
+        "from_device_plane": saw_device_plane,
+    }
+
+
+def measured_step_breakdown(run_step, steps: int = 4, warmup: int = 1) -> dict:
+    """Profile ``steps`` calls of ``run_step()`` (which must block until the
+    device finishes) and return the MEASURED per-step time split:
+
+    {"step_ms": wall per step,
+     "device_busy_ms": summed op time per step (across local devices),
+     "sync_ms": collective op time per step, "sync_frac": of device_busy_ms,
+     "source": "device-plane" | "host-traceme" | "wall-only"}
+
+    The collective split is the measured analogue of the reference's per-
+    token Sync ms. On multi-device (virtual CPU) meshes op times sum over
+    all local devices, so sync_frac (same multiplicity in numerator and
+    denominator) is the comparable number, not sync_ms itself."""
+    import glob
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+
+    for _ in range(max(0, warmup)):
+        run_step()
+    tmpdir = tempfile.mkdtemp(prefix="dllama-prof-")
+    try:
+        wall = 0.0
+        with jax.profiler.trace(tmpdir):
+            # time each call individually so profiler session start/stop and
+            # the xplane dump don't inflate the per-step number
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                run_step()
+                wall += time.perf_counter() - t0
+        parsed = _parse_xplanes(
+            glob.glob(tmpdir + "/**/*.xplane.pb", recursive=True)
+        )
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    out = {"step_ms": wall / steps * 1e3}
+    if parsed is None or not (parsed["busy_ps"] or parsed["collective_ps"]):
+        out.update(device_busy_ms=None, sync_ms=None, sync_frac=None,
+                   source="wall-only")
+        return out
+    busy_ms = parsed["busy_ps"] / 1e9 / steps
+    sync_ms = parsed["collective_ps"] / 1e9 / steps
+    out.update(
+        device_busy_ms=round(busy_ms, 3),
+        sync_ms=round(sync_ms, 3),
+        sync_frac=round(sync_ms / busy_ms, 4) if busy_ms else None,
+        sync_ms_by_kind={
+            k: round(v / 1e9 / steps, 3)
+            for k, v in parsed["collective_by_kind_ps"].items()
+        },
+        source="device-plane" if parsed["from_device_plane"] else "host-traceme",
+    )
+    return out

@@ -207,6 +207,14 @@ class RootControlEngine:
         )
         return self._engine.decode(tokens, positions, temps, topps, seeds)
 
+    def measured_sync_stats(self, steps: int = 4) -> dict:
+        """Disabled on pod roots: the probe's direct decode calls would not
+        be broadcast to workers, so the SPMD program would deadlock waiting
+        for their matching dispatch. (Without this override __getattr__
+        would happily forward to the inner engine.)"""
+        del steps
+        return {}
+
     def stop_workers(self) -> None:
         self._plane.send_stop()
 

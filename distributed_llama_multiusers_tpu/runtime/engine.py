@@ -379,6 +379,35 @@ class InferenceEngine:
         self._coll_stats = stats
         return stats
 
+    def measured_sync_stats(self, steps: int = 4) -> dict:
+        """MEASURED per-decode-step time split from a profiler trace
+        (parallel/comm_stats.measured_step_breakdown): device busy ms and
+        collective (sync) ms per step — the measured analogue of the
+        reference's per-token Sync readout (src/dllama.cpp:54-64), vs the
+        static byte estimate of ``collective_stats``.
+
+        Benchmark probe: it runs the decode step with zero tokens at
+        position 0 on every lane, which REWRITES cache slot 0 — call it
+        before serving or after generation, not mid-request."""
+        import copy
+
+        from ..parallel.comm_stats import measured_step_breakdown
+
+        z = np.zeros(self.n_lanes, np.int32)
+        zf = np.zeros(self.n_lanes, np.float32)
+        zu = np.zeros(self.n_lanes, np.uint32)
+
+        def step():
+            # decode returns host numpy for greedy, so it has already blocked
+            self.decode(z, z, zf, zf, zu)
+
+        snapshot = copy.copy(self.stats)
+        try:
+            return measured_step_breakdown(step, steps=steps)
+        finally:
+            # the probe's fake steps must not pollute serving counters
+            self.stats.__dict__.update(snapshot.__dict__)
+
     def lane_logits(self, logits, lane: int) -> np.ndarray:
         """Transfer one lane's logits to host (counted, for sampling)."""
         out = np.asarray(logits[lane])
