@@ -171,15 +171,13 @@ def _bench_decode(config, params, n_short, n_long, reps=3, tag=""):
 
     def timed(n_steps):
         gen = make_generate(n_steps)
-        best = float("inf")
-        for _ in range(reps + 1):  # first rep is compile+warmup
+
+        def run():
             cache = init_kv_cache(config, n_lanes=1, dtype=jnp.bfloat16)
-            t0 = time.perf_counter()
-            toks, cache = gen(params, cache, first, pos0)
+            toks, _ = gen(params, cache, first, pos0)
             np.asarray(toks)  # forces completion (block_until_ready may not)
-            dt = time.perf_counter() - t0
-            best = min(best, dt)
-        return best
+
+        return _best_of_reps(run, reps)
 
     t_short = timed(n_short)
     t_long = timed(n_long)
@@ -218,6 +216,43 @@ class _BenchTokenizer:
         return "x"
 
 
+def _best_of_reps(run, reps):
+    """min-of-(reps+1) wall time of run() (first rep doubles as compile +
+    warmup); run must block on the device — np.asarray a result, since
+    block_until_ready can lie through the device tunnel."""
+    best = float("inf")
+    for _ in range(reps + 1):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _bench_prefill(config, params, t_prompt, reps=3):
+    """Seconds for one t_prompt-token prefill (the reference's Eval phase,
+    src/dllama.cpp:36-55: batched prompt eval before decode)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.models import init_kv_cache, llama_forward
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, cache, tokens, positions):
+        logits, cache = llama_forward(config, params, tokens, positions, cache)
+        return jnp.argmax(logits[:, -1, :], axis=-1), cache
+
+    tokens = jnp.zeros((1, t_prompt), jnp.int32)
+    positions = jnp.arange(t_prompt, dtype=jnp.int32)[None, :]
+
+    def run():
+        cache = init_kv_cache(config, n_lanes=1, dtype=jnp.bfloat16)
+        nxt, _ = prefill(params, cache, tokens, positions)
+        np.asarray(nxt)
+
+    return _best_of_reps(run, reps)
+
+
 def _phase_primary(config, platform, device_kind, small):
     import jax
 
@@ -228,6 +263,20 @@ def _phase_primary(config, platform, device_kind, small):
           f"({_tree_device_bytes(params_q)/1e9:.2f} GB)", file=sys.stderr, flush=True)
 
     tok_s = _bench_decode(config, params_q, n_short, n_long, tag="packed+pallas")
+    # prefill is additive: a failure here must not discard the banked decode
+    # number (the round-3 lesson: never lose the primary metric)
+    t_prompt = 16 if small else 128
+    prefill_extra = {}
+    try:
+        prefill_s = _bench_prefill(config, params_q, t_prompt)
+        print(f"[bench] prefill({t_prompt})={prefill_s * 1e3:.1f} ms",
+              file=sys.stderr, flush=True)
+        prefill_extra = {
+            "prefill_tok_s": round(t_prompt / prefill_s, 1),
+            "ttft_ms": round(prefill_s * 1e3, 1),
+        }
+    except Exception as e:  # noqa: BLE001
+        prefill_extra = {"prefill_error": f"{type(e).__name__}: {e}"[:200]}
     weight_bytes = _tree_device_bytes(params_q)
     peak_flops, peak_bw = _chip_spec(str(device_kind))
     flops_tok = _param_matmul_flops_per_token(config)
@@ -241,6 +290,7 @@ def _phase_primary(config, platform, device_kind, small):
         "weight_read_gb_s": round(weight_bytes * tok_s / 1e9, 1),
         "mfu": round(flops_tok * tok_s / peak_flops, 4) if peak_flops else None,
         "hbm_util": round(weight_bytes * tok_s / peak_bw, 3) if peak_bw else None,
+        **prefill_extra,
         "baseline_note": "reference Llama-2-7B on 1x RPi 4B, 0.762 tok/s (report.pdf Fig.3)",
     }
 

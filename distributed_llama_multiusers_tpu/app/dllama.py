@@ -89,6 +89,10 @@ def run_inference(args) -> None:
                 f"{m['device_busy_ms']:.2f} ms device, "
                 f"Sync {m['sync_ms']:.2f} ms ({m['sync_frac'] * 100:.1f}% "
                 f"of device, {m['source']})")
+        elif m.get("step_ms") is not None:
+            # xplane proto unavailable: the probe still measured wall time
+            log("⏱", f"Measured/step: {m['step_ms']:.2f} ms wall "
+                "(no profiler proto; sync split unavailable)")
     if hasattr(engine, "stop_workers"):
         engine.stop_workers()
 

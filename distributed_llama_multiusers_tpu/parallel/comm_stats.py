@@ -180,7 +180,13 @@ def measured_step_breakdown(run_step, steps: int = 4, warmup: int = 1) -> dict:
     The collective split is the measured analogue of the reference's per-
     token Sync ms. On multi-device (virtual CPU) meshes op times sum over
     all local devices, so sync_frac (same multiplicity in numerator and
-    denominator) is the comparable number, not sync_ms itself."""
+    denominator) is the comparable number, not sync_ms itself.
+
+    source="host-traceme" (XLA:CPU) is an APPROXIMATION: busy time counts
+    executable-dispatch spans plus collective thunks (other compute thunks
+    don't emit TraceMes), and CPU collective time is mostly rendezvous wait
+    between the virtual devices sharing one host — treat the split as
+    indicative, and the device-plane numbers (real TPU) as the measurement."""
     import glob
     import shutil
     import tempfile
