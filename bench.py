@@ -174,8 +174,10 @@ def _bench_decode(config, params, n_short, n_long, reps=3, tag=""):
 
         def run():
             cache = init_kv_cache(config, n_lanes=1, dtype=jnp.bfloat16)
+            t0 = time.perf_counter()
             toks, _ = gen(params, cache, first, pos0)
             np.asarray(toks)  # forces completion (block_until_ready may not)
+            return time.perf_counter() - t0
 
         return _best_of_reps(run, reps)
 
@@ -217,15 +219,12 @@ class _BenchTokenizer:
 
 
 def _best_of_reps(run, reps):
-    """min-of-(reps+1) wall time of run() (first rep doubles as compile +
-    warmup); run must block on the device — np.asarray a result, since
-    block_until_ready can lie through the device tunnel."""
-    best = float("inf")
-    for _ in range(reps + 1):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    return best
+    """min-of-(reps+1) of run()'s self-reported seconds (first rep doubles
+    as compile + warmup). run times its own measured segment so setup (e.g.
+    allocating the donated KV cache) stays OUTSIDE the window, and must
+    block on the device — np.asarray a result, since block_until_ready can
+    lie through the device tunnel."""
+    return min(run() for _ in range(reps + 1))
 
 
 def _bench_prefill(config, params, t_prompt, reps=3):
@@ -247,8 +246,10 @@ def _bench_prefill(config, params, t_prompt, reps=3):
 
     def run():
         cache = init_kv_cache(config, n_lanes=1, dtype=jnp.bfloat16)
+        t0 = time.perf_counter()
         nxt, _ = prefill(params, cache, tokens, positions)
         np.asarray(nxt)
+        return time.perf_counter() - t0
 
     return _best_of_reps(run, reps)
 

@@ -90,9 +90,10 @@ def run_inference(args) -> None:
                 f"Sync {m['sync_ms']:.2f} ms ({m['sync_frac'] * 100:.1f}% "
                 f"of device, {m['source']})")
         elif m.get("step_ms") is not None:
-            # xplane proto unavailable: the probe still measured wall time
+            # the probe still measured wall time; the split needs a parsable
+            # non-empty xplane trace (missing proto OR empty trace)
             log("⏱", f"Measured/step: {m['step_ms']:.2f} ms wall "
-                "(no profiler proto; sync split unavailable)")
+                "(sync split unavailable: empty or missing profiler trace)")
     if hasattr(engine, "stop_workers"):
         engine.stop_workers()
 

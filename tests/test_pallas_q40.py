@@ -53,6 +53,14 @@ def test_f16_bit_conversion_exact():
         (2, 256, 11008),
         # and its tp=2 shard: d_out <= 8192, 512-multiple + 384 remainder
         (2, 256, 5504),
+        # multi-chunk reduction (n_k > 1): half=2048 x W=2048 exceeds the
+        # single-slab budget, exercising the k-axis accumulator
+        (4, 4096, 2048),
+        # wide-tile grid (j > 1): d_out 16384 tiles as 2 x 8192
+        (2, 512, 16384),
+        # multiple m tiles: m_pad 512 = 2 x 256 with full-extent checks on
+        # the bsum lane dim
+        (300, 64, 256),
     ],
 )
 def test_pallas_matches_xla(m, d_in, d_out):
