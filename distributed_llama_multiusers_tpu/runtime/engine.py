@@ -162,10 +162,12 @@ class InferenceEngine:
             # sampling fused into the compiled step: a sampled lane costs a
             # 4-byte token transfer, not a [vocab] f32 row (VERDICT Weak #3)
             sampled = self._sample_lanes(step, temps, topps, seeds, positions, greedy)
+            # greedy+sampled stacked into ONE [2, n] array: a decode step
+            # costs a single device->host round trip, not two (the transfer
+            # is latency-bound — 8 bytes/lane payload)
             return (
                 replicate(step),
-                replicate(greedy),
-                replicate(sampled),
+                replicate(jnp.stack([greedy, sampled])),
                 cache,
             )
 
@@ -204,8 +206,7 @@ class InferenceEngine:
             )
             return (
                 replicate(last),
-                replicate(greedy),
-                replicate(sampled),
+                replicate(jnp.stack([greedy, sampled])),
                 KVCache(k=k, v=v),
             )
 
@@ -252,7 +253,7 @@ class InferenceEngine:
         bucket = self.bucket_for(len(chunk))
         padded = np.zeros(bucket, np.int32)
         padded[: len(chunk)] = chunk
-        last, greedy, sampled, self.cache = self._prefill_fn(
+        last, toks, self.cache = self._prefill_fn(
             self.params,
             self.cache,
             jnp.int32(lane),
@@ -263,9 +264,10 @@ class InferenceEngine:
             jnp.float32(topp),
             jnp.uint32(seed & 0xFFFFFFFF),
         )
-        greedy = int(greedy)
-        sampled = int(sampled)
-        self.stats.host_bytes_in += 8
+        toks_np = np.asarray(toks)  # one [2] transfer: greedy, sampled
+        greedy = int(toks_np[0])
+        sampled = int(toks_np[1])
+        self.stats.host_bytes_in += toks_np.nbytes
         self.stats.prefill_s += time.perf_counter() - t0
         self.stats.prefill_tokens += len(chunk)
         return last, greedy, sampled
@@ -317,7 +319,7 @@ class InferenceEngine:
             seeds = np.zeros(n, np.uint32)
         t0 = time.perf_counter()
         fn = self._decode_exec if self._decode_exec is not None else self._decode_fn
-        logits, greedy, sampled, self.cache = fn(
+        logits, toks, self.cache = fn(
             self.params,
             self.cache,
             jnp.asarray(tokens, jnp.int32),
@@ -326,9 +328,9 @@ class InferenceEngine:
             jnp.asarray(topps, jnp.float32),
             jnp.asarray(seeds, jnp.uint32),
         )
-        greedy_np = np.asarray(greedy)
-        sampled_np = np.asarray(sampled)
-        self.stats.host_bytes_in += greedy_np.nbytes + sampled_np.nbytes
+        toks_np = np.asarray(toks)  # ONE [2, n] transfer: greedy, sampled
+        greedy_np, sampled_np = toks_np[0], toks_np[1]
+        self.stats.host_bytes_in += toks_np.nbytes
         self.stats.decode_s += time.perf_counter() - t0
         self.stats.decode_steps += 1
         return logits, greedy_np, sampled_np
