@@ -207,6 +207,10 @@ class RootControlEngine:
         )
         return self._engine.decode(tokens, positions, temps, topps, seeds)
 
+    # speculative decode is a different compiled program; the control plane
+    # does not broadcast it, so pods run plain decode (scheduler checks this)
+    supports_speculative = False
+
     def measured_sync_stats(self, steps: int = 4) -> dict:
         """Disabled on pod roots: the probe's direct decode calls would not
         be broadcast to workers, so the SPMD program would deadlock waiting

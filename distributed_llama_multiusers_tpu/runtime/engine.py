@@ -45,6 +45,8 @@ class EngineStats:
     prefill_tokens: int = 0
     decode_steps: int = 0
     host_bytes_in: int = 0  # device->host logits/token traffic
+    spec_steps: int = 0  # speculative verify steps
+    spec_emitted: int = 0  # tokens emitted by spec steps (>= spec_steps)
     # estimated per-step collective payload (bytes/chip), from the compiled
     # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
     sync_bytes_per_decode: int = 0
@@ -170,6 +172,56 @@ class InferenceEngine:
                 replicate(jnp.stack([greedy, sampled])),
                 cache,
             )
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _decode_spec(params, cache, tokens, drafts, draft_len, positions,
+                         temps, topps, seeds):
+            """Speculative decode: verify K = 1 + n_draft tokens per lane in
+            ONE forward (prompt-lookup speculation — decode is weight-read-
+            bound, so a K-token step costs the same HBM traffic as a 1-token
+            step and emits up to K tokens on greedy lanes when drafts hit).
+
+            tokens [n]: each lane's real next token. drafts [n, K-1]: draft
+            continuations (garbage beyond draft_len). draft_len [n]: 0 for
+            sampled/undrafted lanes. Emits greedy[t] for the longest prefix
+            where draft[t+1] == greedy[t], plus the model's own continuation
+            — exactly the tokens plain greedy decode would produce, in the
+            same order (standard speculative-verification identity).
+
+            Cache safety: all K positions get KV writes; slots past the
+            accepted prefix stay uncommitted (per-lane pos only advances by
+            what the scheduler consumes) and are rewritten before any query
+            can read them — the same invariant chunked prefill relies on.
+            The scheduler must keep pos + K <= seq_len (it falls back to
+            plain decode near the end of a lane's sequence)."""
+            full = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [n, K]
+            k_spec = full.shape[1]
+            pos2d = positions[:, None] + jnp.arange(k_spec, dtype=jnp.int32)
+            logits, cache = llama_forward(
+                cfg, params, full, pos2d, cache,
+                emulate_q80_activations=q80, mesh=sp_mesh, q80_sync=q80s,
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [n, K]
+            match = (full[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
+            lead = jnp.cumprod(match, axis=1)  # leading-match indicator
+            in_draft = (
+                jnp.arange(k_spec - 1, dtype=jnp.int32)[None, :]
+                < draft_len[:, None]
+            )
+            accepted = jnp.sum(lead * in_draft, axis=1).astype(jnp.int32)
+            n_emit = accepted + 1  # [n]
+            # lane 0-position sample for temp>0 lanes (their draft_len is 0)
+            sampled0 = self._sample_lanes(
+                logits[:, 0, :], temps, topps, seeds, positions, greedy[:, 0]
+            )
+            emitted = greedy.at[:, 0].set(
+                jnp.where(temps > 0.0, sampled0, greedy[:, 0])
+            )
+            # ONE [n, K+1] transfer: emitted tokens + emit count
+            packed_out = jnp.concatenate([emitted, n_emit[:, None]], axis=1)
+            return replicate(logits[:, 0, :]), replicate(packed_out), cache
+
+        self._decode_spec_fn = _decode_spec
 
         @partial(jax.jit, donate_argnums=(1,))
         def _prefill(params, cache, lane, tokens, start_pos, n_tokens,
@@ -334,6 +386,57 @@ class InferenceEngine:
         self.stats.decode_s += time.perf_counter() - t0
         self.stats.decode_steps += 1
         return logits, greedy_np, sampled_np
+
+    # drafts per speculative step (K = SPEC_DRAFT + 1 verified tokens)
+    SPEC_DRAFT = 3
+    supports_speculative = True  # RootControlEngine overrides to False
+
+    def decode_spec(
+        self,
+        tokens: np.ndarray,
+        drafts: np.ndarray,
+        draft_len: np.ndarray,
+        positions: np.ndarray,
+        temps: np.ndarray | None = None,
+        topps: np.ndarray | None = None,
+        seeds: np.ndarray | None = None,
+    ):
+        """One speculative decode step for all lanes: verifies each lane's
+        next token plus up to SPEC_DRAFT drafted continuations in a single
+        forward. tokens/positions/draft_len: [n_lanes]; drafts:
+        [n_lanes, SPEC_DRAFT]. Greedy lanes emit their plain-decode token
+        stream exactly (speculative-verification identity); temp>0 lanes
+        must pass draft_len 0 and emit one fused-sampled token.
+
+        Caller contract: positions[i] + SPEC_DRAFT + 1 <= seq_len for every
+        lane (use plain ``decode`` otherwise). Returns (step_logits
+        [n, vocab] device array, emitted np[n, K], n_emit np[n])."""
+        n = self.n_lanes
+        if temps is None:
+            temps = np.zeros(n, np.float32)
+        if topps is None:
+            topps = np.full(n, 0.9, np.float32)
+        if seeds is None:
+            seeds = np.zeros(n, np.uint32)
+        t0 = time.perf_counter()
+        logits, packed_out, self.cache = self._decode_spec_fn(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(drafts, jnp.int32),
+            jnp.asarray(draft_len, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topps, jnp.float32),
+            jnp.asarray(seeds, jnp.uint32),
+        )
+        out_np = np.asarray(packed_out)  # ONE [n, K+1] transfer
+        emitted, n_emit = out_np[:, :-1], out_np[:, -1]
+        self.stats.host_bytes_in += out_np.nbytes
+        self.stats.decode_s += time.perf_counter() - t0
+        self.stats.decode_steps += 1
+        self.stats.spec_steps += 1
+        return logits, emitted, n_emit
 
     def sample_token(
         self, logits_row, temp: float, topp: float, seed: int, pos: int

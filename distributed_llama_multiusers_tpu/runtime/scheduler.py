@@ -110,6 +110,17 @@ class _Lane:
     pending: list[int] = field(default_factory=list)  # unprocessed prompt tail
     seed: int = 0
     host_exact: bool = False  # route this lane through the host Sampler
+    # speculation state: committed token history (prompt + consumed) and an
+    # incrementally-maintained n-gram -> last-start-position index, so the
+    # per-step draft lookup is O(1) instead of a backward history scan
+    hist: list[int] = field(default_factory=list)
+    ngrams: dict = field(default_factory=dict)
+
+    def hist_append(self, tok: int) -> None:
+        self.hist.append(tok)
+        for g in (2, 3):
+            if len(self.hist) >= g:
+                self.ngrams[(g, tuple(self.hist[-g:]))] = len(self.hist) - g
 
 
 # The fused on-device sampler truncates to the top-`device_topk` logits
@@ -206,6 +217,8 @@ class ContinuousBatchingScheduler:
         lane.request = req
         lane.pos = 0
         lane.pending = list(tokens)
+        for t in tokens:  # seed the speculation history with the prompt
+            lane.hist_append(t)
         lane.seed = (
             req.seed if req.seed is not None else int(time.time() * 1e6)
         ) & 0xFFFFFFFF
@@ -268,6 +281,56 @@ class ContinuousBatchingScheduler:
             first = int(sampled)  # sampled inside the compiled prefill step
         lane.next_token = first
         req.state = RequestState.GENERATING
+        return True
+
+    def _draft_tokens(self, lane: _Lane) -> list[int]:
+        """Prompt-lookup speculation (greedy lanes only): find the previous
+        occurrence of the current suffix n-gram in (prompt + generated) and
+        propose the tokens that followed it. No draft model — repetitive
+        spans (code, quotes, structured text) are where drafts hit. O(1)
+        per step via the lane's incremental n-gram index (the suffix gram
+        ends at next_token, which is not yet committed, so a probe hit is
+        always a strictly earlier occurrence)."""
+        k = getattr(self.engine, "SPEC_DRAFT", 0)
+        hist = lane.hist
+        for g in (3, 2):
+            if len(hist) < g - 1:
+                continue
+            tail = (*hist[len(hist) - g + 1:], lane.next_token)
+            j = lane.ngrams.get((g, tail))
+            if j is not None:
+                cont = hist[j + g : j + g + k]
+                if cont:
+                    return cont
+        return []
+
+    def _consume(self, lane_idx: int, lane: _Lane, tok: int) -> bool:
+        """Emit one generated token on a lane: stream-decode, EOS/stop
+        detection, delta callbacks, position advance, length check. Returns
+        False when the lane finished (EOS or length)."""
+        req = lane.request
+        req.generated_tokens.append(tok)
+        lane.hist_append(tok)
+        piece = lane.decoder.decode(tok)
+        result = lane.eos.append(tok, piece)
+        if result == EosResult.EOS:
+            self._finish(lane_idx, req)
+            return False
+        if result == EosResult.NOT_EOS:
+            delta = lane.eos.get_delta()
+            if delta:
+                req.generated_text += delta
+                if req.on_delta:
+                    req.on_delta(delta)
+            lane.eos.reset()
+        # MAYBE_EOS: hold back
+        lane.pos += 1
+        if (
+            len(req.generated_tokens) >= req.max_tokens
+            or lane.pos >= self.engine.config.seq_len
+        ):
+            self._finish(lane_idx, req, reason="length")
+            return False
         return True
 
     def _finish(self, lane_idx: int, req: Request, reason: str = "stop") -> None:
@@ -334,9 +397,39 @@ class ContinuousBatchingScheduler:
                     temps[i] = lane.request.temperature
                     topps[i] = lane.request.topp
                     seeds[i] = lane.seed
-            logits, greedy, sampled = self.engine.decode(
-                tokens, positions, temps, topps, seeds
-            )
+
+            # speculative step (prompt-lookup drafts, greedy lanes): only
+            # when every occupied lane has K uncommitted cache slots left —
+            # near seq_len the draft scribbles could clobber committed state,
+            # so those steps fall back to plain decode
+            spec_k = getattr(self.engine, "SPEC_DRAFT", 0)
+            draft_len = None
+            if (
+                spec_k > 0
+                and getattr(self.engine, "supports_speculative", False)
+                and all(
+                    l.request is None or l.pos + spec_k + 1 <= cfg.seq_len
+                    for l in self._lanes
+                )
+            ):
+                drafts = np.zeros((n_lanes, spec_k), np.int32)
+                draft_len = np.zeros(n_lanes, np.int32)
+                for i, lane in active:
+                    if lane.request.temperature == 0.0:
+                        d = self._draft_tokens(lane)
+                        drafts[i, : len(d)] = d
+                        draft_len[i] = len(d)
+                if not draft_len.any():
+                    draft_len = None  # nothing to verify: plain step
+
+            if draft_len is not None:
+                logits, emitted, n_emit = self.engine.decode_spec(
+                    tokens, drafts, draft_len, positions, temps, topps, seeds
+                )
+            else:
+                logits, greedy, sampled = self.engine.decode(
+                    tokens, positions, temps, topps, seeds
+                )
             # host-exact lanes (global host_sampling mode, or per-request
             # fallback for near-1.0 top-p / very high temperature where the
             # device sampler's top-k truncation would distort): one batched
@@ -349,35 +442,36 @@ class ContinuousBatchingScheduler:
 
             for i, lane in active:
                 req = lane.request
-                emitted = lane.next_token
-                req.generated_tokens.append(emitted)
-                piece = lane.decoder.decode(emitted)
-                result = lane.eos.append(emitted, piece)
-                if result == EosResult.EOS:
-                    self._finish(i, req)
-                    continue
-                if result == EosResult.NOT_EOS:
-                    delta = lane.eos.get_delta()
-                    if delta:
-                        req.generated_text += delta
-                        if req.on_delta:
-                            req.on_delta(delta)
-                    lane.eos.reset()
-                # MAYBE_EOS: hold back
-
-                lane.pos += 1
-                if (
-                    len(req.generated_tokens) >= req.max_tokens
-                    or lane.pos >= cfg.seq_len
-                ):
-                    self._finish(i, req, reason="length")
-                    continue
+                if draft_len is not None:
+                    # feed sequence: next_token + the accepted drafts (they
+                    # equal the greedy continuations, so this is exactly the
+                    # plain-decode token stream); the model's token after
+                    # the accepted prefix becomes the new pending token
+                    cnt = int(n_emit[i])
+                    seq = [lane.next_token] + [
+                        int(t) for t in emitted[i, : cnt - 1]
+                    ]
+                    alive = True
+                    for t in seq:
+                        self.engine.stats.spec_emitted += 1  # consumed only
+                        if not self._consume(i, lane, t):
+                            alive = False
+                            break
+                    if not alive:
+                        continue
+                    nxt_greedy = int(emitted[i, cnt - 1])
+                    nxt_sampled = int(emitted[i, 0])  # n_emit==1 for temp>0
+                else:
+                    if not self._consume(i, lane, lane.next_token):
+                        continue
+                    nxt_greedy = int(greedy[i])
+                    nxt_sampled = int(sampled[i])
                 if req.temperature == 0.0:
-                    lane.next_token = int(greedy[i])
+                    lane.next_token = nxt_greedy
                 elif lane.host_exact:
                     lane.next_token = lane.sampler.sample(logits_np[i])
                 else:
-                    lane.next_token = int(sampled[i])
+                    lane.next_token = nxt_sampled
         # drain: resolve everything still in flight so no client hangs
         for i, lane in enumerate(self._lanes):
             if lane.request is not None:

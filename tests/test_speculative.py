@@ -1,0 +1,167 @@
+"""Prompt-lookup speculative decoding (capability extension — the reference
+has nothing comparable; src/app.cpp:314-402 decodes strictly one token per
+forward per lane).
+
+The invariant under test is the speculative-verification identity: greedy
+lanes must emit EXACTLY the token stream plain decode would produce — drafts
+only change how many forwards that stream costs. Cache correctness after a
+spec step matters as much as the emitted tokens: the accepted prefix's KV
+writes come from the verify forward itself.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from distributed_llama_multiusers_tpu.formats import load_model_header
+from distributed_llama_multiusers_tpu.models import load_params_from_m
+from distributed_llama_multiusers_tpu.runtime import (
+    ContinuousBatchingScheduler,
+    InferenceEngine,
+    Request,
+)
+from distributed_llama_multiusers_tpu.tokenizer import Tokenizer
+
+
+@pytest.fixture(scope="module")
+def loaded(tiny_model):
+    h = load_model_header(tiny_model["model"])
+    config, params = load_params_from_m(tiny_model["model"], h, dtype=jnp.float32)
+    tok = Tokenizer(tiny_model["tokenizer"])
+    return config, params, tok
+
+
+def _fresh_engine(config, params, n_lanes=2):
+    return InferenceEngine(config, params, n_lanes=n_lanes, prefill_buckets=(4,))
+
+
+def _greedy_rollout(engine, prompt, n):
+    """Plain greedy decode of n tokens on lane 0; returns produced tokens."""
+    _, g, pos = engine.prefill(0, prompt)
+    toks = [int(g)]
+    tokens = np.zeros(engine.n_lanes, np.int32)
+    positions = np.zeros(engine.n_lanes, np.int32)
+    for _ in range(n - 1):
+        tokens[0], positions[0] = toks[-1], pos
+        _, greedy, _ = engine.decode(tokens, positions)
+        toks.append(int(greedy[0]))
+        pos += 1
+    return toks
+
+
+def test_spec_accepts_correct_drafts(loaded):
+    """A draft equal to the greedy continuation is fully accepted, the
+    emitted tokens match plain decode, and the cache state after the spec
+    step supports identical further decoding."""
+    config, params, tok = loaded
+    prompt = [5, 9, 3]
+    ref = _greedy_rollout(_fresh_engine(config, params), prompt, 7)
+
+    engine = _fresh_engine(config, params)
+    _, g0, pos = engine.prefill(0, prompt)
+    assert int(g0) == ref[0]
+    k = engine.SPEC_DRAFT
+    tokens = np.zeros(engine.n_lanes, np.int32)
+    positions = np.zeros(engine.n_lanes, np.int32)
+    drafts = np.zeros((engine.n_lanes, k), np.int32)
+    dlen = np.zeros(engine.n_lanes, np.int32)
+    tokens[0], positions[0] = ref[0], pos
+    drafts[0] = ref[1 : 1 + k]
+    dlen[0] = k
+    _, emitted, n_emit = engine.decode_spec(tokens, drafts, dlen, positions)
+    assert int(n_emit[0]) == k + 1  # every draft accepted + the bonus token
+    assert [int(t) for t in emitted[0]] == ref[1 : k + 2]
+
+    # the spec step's KV writes must be the real thing: continue plain
+    # decoding from the accepted state and match the reference stream
+    pos += k + 1
+    tokens[0], positions[0] = ref[k + 1], pos
+    _, greedy, _ = engine.decode(tokens, positions)
+    assert int(greedy[0]) == ref[k + 2]
+
+
+def test_spec_rejects_wrong_drafts(loaded):
+    """A mismatching draft yields exactly the plain-decode token and nothing
+    else (n_emit == 1)."""
+    config, params, tok = loaded
+    prompt = [5, 9, 3]
+    ref = _greedy_rollout(_fresh_engine(config, params), prompt, 5)
+
+    engine = _fresh_engine(config, params)
+    _, _, pos = engine.prefill(0, prompt)
+    k = engine.SPEC_DRAFT
+    tokens = np.zeros(engine.n_lanes, np.int32)
+    positions = np.zeros(engine.n_lanes, np.int32)
+    drafts = np.zeros((engine.n_lanes, k), np.int32)
+    dlen = np.zeros(engine.n_lanes, np.int32)
+    tokens[0], positions[0] = ref[0], pos
+    drafts[0] = [(t + 1) % config.vocab_size for t in ref[1 : 1 + k]]  # wrong
+    dlen[0] = k
+    _, emitted, n_emit = engine.decode_spec(tokens, drafts, dlen, positions)
+    assert int(n_emit[0]) == 1
+    assert int(emitted[0, 0]) == ref[1]
+
+
+def _run_requests(engine, tok, reqs):
+    sched = ContinuousBatchingScheduler(engine, tok)
+    sched.start()
+    try:
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            r.future.result(timeout=300)
+    finally:
+        sched.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    return [list(r.generated_tokens) for r in reqs]
+
+
+def test_scheduler_spec_matches_plain(loaded, monkeypatch):
+    """End-to-end scheduler parity: the same mixed batch (greedy + seeded
+    sampled) generates identical token streams with speculation on and off."""
+    config, params, tok = loaded
+
+    def reqs():
+        return [
+            Request(prompt="hello world hello world hello", max_tokens=12,
+                    temperature=0.0),
+            Request(prompt="aa bb aa bb aa", max_tokens=10, temperature=0.0),
+            Request(prompt="sampled one", max_tokens=8, temperature=0.8,
+                    seed=123),
+        ]
+
+    spec_engine = _fresh_engine(config, params, n_lanes=4)
+    got_spec = _run_requests(spec_engine, tok, reqs())
+    assert spec_engine.stats.spec_steps > 0
+
+    plain_engine = _fresh_engine(config, params, n_lanes=4)
+    monkeypatch.setattr(
+        type(plain_engine), "supports_speculative", False, raising=True
+    )
+    try:
+        got_plain = _run_requests(plain_engine, tok, reqs())
+    finally:
+        monkeypatch.undo()
+    assert got_spec == got_plain
+
+
+def test_scheduler_spec_near_seq_len(loaded):
+    """Lanes approaching seq_len must fall back to plain decode instead of
+    scribbling past the end; generation completes cleanly at the length
+    cap."""
+    config, params, tok = loaded
+    engine = _fresh_engine(config, params, n_lanes=2)
+    r = Request(prompt="aa bb aa bb", max_tokens=config.seq_len,
+                temperature=0.0)
+    out = _run_requests(engine, tok, [r])[0]
+    assert r.finish_reason in ("length", "stop")
+    assert len(out) >= 1
+
+
+def test_pod_root_engine_disables_spec():
+    from distributed_llama_multiusers_tpu.parallel.multihost import (
+        RootControlEngine,
+    )
+
+    assert RootControlEngine.supports_speculative is False
+    assert InferenceEngine.supports_speculative is True
