@@ -17,7 +17,7 @@ def main(argv=None) -> None:
     honor_cpu_platform_env()
     args = build_parser("dllama-api", api=True).parse_args(argv)
     config, params, tokenizer, engine = load_stack(args)
-    scheduler = make_scheduler(engine, tokenizer)
+    scheduler = make_scheduler(engine, tokenizer, args)
     template_type = template_type_from_name(args.chat_template)
     model_name = os.path.basename(args.model or "dllama")
     server = ApiServer(scheduler, tokenizer, model_name=model_name, template_type=template_type)

@@ -154,7 +154,11 @@ def load_stack(args, n_lanes: int | None = None):
     return config, params, tokenizer, engine
 
 
-def make_scheduler(engine, tokenizer) -> ContinuousBatchingScheduler:
-    sched = ContinuousBatchingScheduler(engine, tokenizer)
+def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
+    sched = ContinuousBatchingScheduler(
+        engine,
+        tokenizer,
+        speculative=not getattr(args, "no_spec", False),
+    )
     sched.start()
     return sched

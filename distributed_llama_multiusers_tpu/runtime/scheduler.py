@@ -141,16 +141,22 @@ class ContinuousBatchingScheduler:
         queue_: RequestQueue | None = None,
         eos_padding: tuple[int, int] = (2, 2),
         host_sampling: bool = False,
+        speculative: bool = True,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
         per token); the default samples on device inside the compiled decode
-        step, transferring only the 4-byte token per lane."""
+        step, transferring only the 4-byte token per lane.
+
+        ``speculative=False`` disables prompt-lookup speculative decoding
+        (greedy-lane draft verification); it is otherwise used automatically
+        whenever the engine supports it."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.queue = queue_ or RequestQueue()
         self.eos_padding = eos_padding
         self.host_sampling = host_sampling
+        self.speculative = speculative
         self._lanes = [_Lane() for _ in range(engine.n_lanes)]
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -405,7 +411,8 @@ class ContinuousBatchingScheduler:
             spec_k = getattr(self.engine, "SPEC_DRAFT", 0)
             draft_len = None
             if (
-                spec_k > 0
+                self.speculative
+                and spec_k > 0
                 and getattr(self.engine, "supports_speculative", False)
                 and all(
                     l.request is None or l.pos + spec_k + 1 <= cfg.seq_len
