@@ -69,7 +69,8 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--net-turbo", type=int, default=1, help=argparse.SUPPRESS)
     p.add_argument("--benchmark", action="store_true", help="print per-token timing stats")
     p.add_argument("--no-spec", action="store_true",
-                   help="disable prompt-lookup speculative decoding in serving")
+                   help="disable prompt-lookup speculative decoding "
+                        "(serving and greedy CLI inference)")
     return p
 
 

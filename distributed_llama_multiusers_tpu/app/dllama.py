@@ -56,6 +56,21 @@ def run_inference(args) -> None:
     # so every process compiles identical decode shapes)
     toks = np.zeros(engine.n_lanes, np.int32)
     poss = np.zeros(engine.n_lanes, np.int32)
+    # prompt-lookup speculation for greedy runs (exact-stream identity; the
+    # scheduler has the multi-lane version — this is the single-stream one)
+    spec_k = getattr(engine, "SPEC_DRAFT", 0)
+    use_spec = (
+        args.temperature == 0.0
+        and spec_k > 0
+        and getattr(engine, "supports_speculative", False)
+        and not getattr(args, "no_spec", False)
+    )
+    drafter = None
+    if use_spec:
+        from ..runtime.spec import NgramDraftIndex
+
+        drafter = NgramDraftIndex(tokens)
+    pending: list[int] = []  # produced-but-not-yet-emitted spec lookahead
     for _ in range(args.steps):
         piece = tokenizer.decode(cur)
         if piece:
@@ -63,16 +78,47 @@ def run_inference(args) -> None:
             print(piece, end="", flush=True)
         if tokenizer.is_eos(cur) or pos >= config.seq_len:
             break
+        if pending:
+            # cur's cache write already happened in the spec step
+            if drafter is not None:
+                drafter.append(cur)
+            pos += 1
+            pred_times.append(0.0)  # token count for the tok/s summary
+            cur = pending.pop(0)
+            continue
+        draft = (
+            drafter.draft(cur, spec_k)
+            if use_spec and pos + spec_k + 1 <= config.seq_len
+            else []
+        )
+        if drafter is not None:
+            drafter.append(cur)
         toks[0] = cur
         poss[0] = pos
         t1 = time.perf_counter()
-        logits_b, greedy_b, _ = engine.decode(toks, poss)
+        if draft:
+            drafts = np.zeros((engine.n_lanes, spec_k), np.int32)
+            dlen = np.zeros(engine.n_lanes, np.int32)
+            drafts[0, : len(draft)] = draft
+            dlen[0] = len(draft)
+            _, em, ne = engine.decode_spec(toks, drafts, dlen, poss)
+            cnt = int(ne[0])
+            seq = [int(t) for t in em[0, :cnt]]
+            nxt, pending = seq[0], seq[1:]
+        else:
+            logits_b, greedy_b, _ = engine.decode(toks, poss)
+            nxt = (
+                int(greedy_b[0])
+                if args.temperature == 0.0
+                else sampler.sample(engine.lane_logits(logits_b, 0))
+            )
         dt = time.perf_counter() - t1
         pred_times.append(dt)
         if args.benchmark:
-            log("🔶", f"Pred {dt * 1000:8.2f} ms{sync_suffix}")
+            spec_note = f"  (spec +{len(pending)})" if pending else ""
+            log("🔶", f"Pred {dt * 1000:8.2f} ms{sync_suffix}{spec_note}")
         pos += 1
-        cur = int(greedy_b[0]) if args.temperature == 0.0 else sampler.sample(engine.lane_logits(logits_b, 0))
+        cur = nxt
     print()
     if pred_times:
         total = sum(pred_times)

@@ -31,6 +31,7 @@ from typing import Callable
 import numpy as np
 
 from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerChatStops
+from .spec import NgramDraftIndex
 
 
 class RequestState(Enum):
@@ -110,17 +111,9 @@ class _Lane:
     pending: list[int] = field(default_factory=list)  # unprocessed prompt tail
     seed: int = 0
     host_exact: bool = False  # route this lane through the host Sampler
-    # speculation state: committed token history (prompt + consumed) and an
-    # incrementally-maintained n-gram -> last-start-position index, so the
-    # per-step draft lookup is O(1) instead of a backward history scan
-    hist: list[int] = field(default_factory=list)
-    ngrams: dict = field(default_factory=dict)
-
-    def hist_append(self, tok: int) -> None:
-        self.hist.append(tok)
-        for g in (2, 3):
-            if len(self.hist) >= g:
-                self.ngrams[(g, tuple(self.hist[-g:]))] = len(self.hist) - g
+    # speculation state: committed (prompt + consumed) token history with
+    # an O(1) prompt-lookup draft probe (runtime/spec.py)
+    drafter: NgramDraftIndex = field(default_factory=NgramDraftIndex)
 
 
 # The fused on-device sampler truncates to the top-`device_topk` logits
@@ -223,8 +216,7 @@ class ContinuousBatchingScheduler:
         lane.request = req
         lane.pos = 0
         lane.pending = list(tokens)
-        for t in tokens:  # seed the speculation history with the prompt
-            lane.hist_append(t)
+        lane.drafter = NgramDraftIndex(tokens)  # seed with the prompt
         lane.seed = (
             req.seed if req.seed is not None else int(time.time() * 1e6)
         ) & 0xFFFFFFFF
@@ -289,34 +281,13 @@ class ContinuousBatchingScheduler:
         req.state = RequestState.GENERATING
         return True
 
-    def _draft_tokens(self, lane: _Lane) -> list[int]:
-        """Prompt-lookup speculation (greedy lanes only): find the previous
-        occurrence of the current suffix n-gram in (prompt + generated) and
-        propose the tokens that followed it. No draft model — repetitive
-        spans (code, quotes, structured text) are where drafts hit. O(1)
-        per step via the lane's incremental n-gram index (the suffix gram
-        ends at next_token, which is not yet committed, so a probe hit is
-        always a strictly earlier occurrence)."""
-        k = getattr(self.engine, "SPEC_DRAFT", 0)
-        hist = lane.hist
-        for g in (3, 2):
-            if len(hist) < g - 1:
-                continue
-            tail = (*hist[len(hist) - g + 1:], lane.next_token)
-            j = lane.ngrams.get((g, tail))
-            if j is not None:
-                cont = hist[j + g : j + g + k]
-                if cont:
-                    return cont
-        return []
-
     def _consume(self, lane_idx: int, lane: _Lane, tok: int) -> bool:
         """Emit one generated token on a lane: stream-decode, EOS/stop
         detection, delta callbacks, position advance, length check. Returns
         False when the lane finished (EOS or length)."""
         req = lane.request
         req.generated_tokens.append(tok)
-        lane.hist_append(tok)
+        lane.drafter.append(tok)
         piece = lane.decoder.decode(tok)
         result = lane.eos.append(tok, piece)
         if result == EosResult.EOS:
@@ -423,7 +394,7 @@ class ContinuousBatchingScheduler:
                 draft_len = np.zeros(n_lanes, np.int32)
                 for i, lane in active:
                     if lane.request.temperature == 0.0:
-                        d = self._draft_tokens(lane)
+                        d = lane.drafter.draft(lane.next_token, spec_k)
                         drafts[i, : len(d)] = d
                         draft_len[i] = len(d)
                 if not draft_len.any():

@@ -1,0 +1,49 @@
+"""Prompt-lookup draft index for speculative decoding.
+
+Drafts come from the token stream itself: the previous occurrence of the
+current suffix n-gram (3-gram, falling back to 2-gram) proposes the tokens
+that followed it — no draft model. The index is maintained incrementally
+(each committed token updates two dict entries), so a draft probe is O(1)
+per step instead of a backward history scan.
+
+Used by the continuous-batching scheduler (per lane) and the CLI inference
+loop (single stream). The engine's verify program
+(`InferenceEngine.decode_spec`) guarantees the speculative-verification
+identity: greedy output streams are exactly the plain-decode streams.
+"""
+
+from __future__ import annotations
+
+
+class NgramDraftIndex:
+    """Committed token history + n-gram -> last-start-position index."""
+
+    GRAM_SIZES = (2, 3)
+
+    def __init__(self, tokens=()):
+        self.hist: list[int] = []
+        self._last: dict = {}
+        for t in tokens:
+            self.append(t)
+
+    def append(self, tok: int) -> None:
+        self.hist.append(tok)
+        for g in self.GRAM_SIZES:
+            if len(self.hist) >= g:
+                self._last[(g, tuple(self.hist[-g:]))] = len(self.hist) - g
+
+    def draft(self, next_token: int, k: int) -> list[int]:
+        """Up to k draft tokens continuing (hist + [next_token]). The probe
+        gram ends at next_token, which is not yet committed, so a hit is
+        always a strictly earlier occurrence."""
+        hist = self.hist
+        for g in sorted(self.GRAM_SIZES, reverse=True):
+            if len(hist) < g - 1:
+                continue
+            tail = (*hist[len(hist) - g + 1:], next_token)
+            j = self._last.get((g, tail))
+            if j is not None:
+                cont = hist[j + g : j + g + k]
+                if cont:
+                    return cont
+        return []
