@@ -5,6 +5,7 @@ validate -> tokenizer -> build model -> place on devices -> engine."""
 from __future__ import annotations
 
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -155,10 +156,15 @@ def load_stack(args, n_lanes: int | None = None):
 
 
 def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
+    from ..runtime.engine import warmup_engine
+
+    speculative = not getattr(args, "no_spec", False)
+    log("⏳", "Warming serving programs (prefill buckets, decode, spec)...")
+    t0 = time.perf_counter()
+    warmup_engine(engine, spec=speculative)
+    log("⏳", f"Warmup done in {time.perf_counter() - t0:.1f}s")
     sched = ContinuousBatchingScheduler(
-        engine,
-        tokenizer,
-        speculative=not getattr(args, "no_spec", False),
+        engine, tokenizer, speculative=speculative
     )
     sched.start()
     return sched

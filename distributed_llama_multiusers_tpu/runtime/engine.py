@@ -58,6 +58,21 @@ class EngineStats:
         self.prefill_tokens = self.decode_steps = self.host_bytes_in = 0
         return snap
 
+    def preserved(self):
+        """Context manager: restore all counters on exit — for probes and
+        warmup, whose fake engine calls must not pollute serving totals."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            snap = dict(self.__dict__)
+            try:
+                yield self
+            finally:
+                self.__dict__.update(snap)
+
+        return cm()
+
 
 class InferenceEngine:
     def __init__(
@@ -494,8 +509,6 @@ class InferenceEngine:
         Benchmark probe: it runs the decode step with zero tokens at
         position 0 on every lane, which REWRITES cache slot 0 — call it
         before serving or after generation, not mid-request."""
-        import copy
-
         from ..parallel.comm_stats import measured_step_breakdown
 
         z = np.zeros(self.n_lanes, np.int32)
@@ -506,12 +519,8 @@ class InferenceEngine:
             # decode returns host numpy for greedy, so it has already blocked
             self.decode(z, z, zf, zf, zu)
 
-        snapshot = copy.copy(self.stats)
-        try:
+        with self.stats.preserved():
             return measured_step_breakdown(step, steps=steps)
-        finally:
-            # the probe's fake steps must not pollute serving counters
-            self.stats.__dict__.update(snapshot.__dict__)
 
     def lane_logits(self, logits, lane: int) -> np.ndarray:
         """Transfer one lane's logits to host (counted, for sampling)."""
@@ -528,3 +537,28 @@ class InferenceEngine:
     def reset_lane(self, lane: int) -> None:
         """Nothing to clear on device: a fresh request's prefill rewrites the
         lane's cache from position 0, and reads are masked to s <= pos."""
+
+
+def warmup_engine(engine, spec: bool = True) -> None:
+    """Compile every serving program up front (each prefill bucket, decode,
+    and the speculative verify step) so the first real request doesn't pay
+    XLA compiles mid-service — the analogue of the reference finishing its
+    executor build before accepting connections (src/app.cpp:233-312).
+
+    Deliberately a FREE function driving the PUBLIC engine API: on a
+    multi-host pod root the proxy's decode/prefill_chunk broadcast control
+    packets so workers replay the same compiles; an InferenceEngine method
+    reached through the proxy's __getattr__ would bypass the broadcast and
+    deadlock the mesh. The junk KV writes land in uncommitted slots
+    (admission rewrites from position 0) and the stats counters are
+    restored afterwards."""
+    n = engine.n_lanes
+    z = np.zeros(n, np.int32)
+    with engine.stats.preserved():
+        for bucket in engine.prefill_buckets:
+            engine.prefill_chunk(0, [0] * bucket, 0)
+        engine.decode(z, z)
+        if spec and getattr(engine, "supports_speculative", False):
+            engine.decode_spec(
+                z, np.zeros((n, engine.SPEC_DRAFT), np.int32), z, z
+            )
