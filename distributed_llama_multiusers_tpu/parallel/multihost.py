@@ -28,6 +28,7 @@ import numpy as np
 OP_STOP = 0
 OP_PREFILL = 1
 OP_DECODE = 2
+OP_DECODE_SPEC = 3
 
 
 def maybe_initialize_distributed(args=None) -> int:
@@ -73,14 +74,21 @@ class ControlPlane:
     every process must dispatch the identical compiled decode (sampling is
     fused into it), so the sampling arguments ride the control packet the
     way position/batchSize ride LlmControlPacket (src/app.cpp:198-209).
+    DECODE_SPEC: the DECODE slots plus payload_f = draft tokens (flattened
+    [n_lanes * SPEC_DRAFT]) and payload_g = per-lane draft lengths, so
+    speculative verify steps replay on pods too.
     """
 
     HEADER = 4
-    SLOTS = 5
+    SLOTS = 7
 
     def __init__(self, n_lanes: int, chunk: int = 1024):
+        from ..runtime.spec import SPEC_DRAFT
+
         self.n_lanes = n_lanes
-        self.chunk = max(chunk, n_lanes)
+        # every slot must fit its largest payload: prompt chunks (chunk),
+        # per-lane vectors (n_lanes), and the flattened spec drafts
+        self.chunk = max(chunk, n_lanes, n_lanes * SPEC_DRAFT)
         self._size = self.HEADER + self.SLOTS * self.chunk
 
     def _bcast(self, pkt: np.ndarray) -> np.ndarray:
@@ -126,6 +134,26 @@ class ControlPlane:
             OP_DECODE, 0, n, 0,
             tokens, positions, as_bits(temps), as_bits(topps),
             None if seeds is None else np.asarray(seeds, np.uint32).view(np.int32),
+        )
+
+    def send_decode_spec(
+        self, tokens, drafts, draft_len, positions, temps, topps, seeds
+    ) -> None:
+        n = len(tokens)
+        flat = np.asarray(drafts, np.int32).reshape(-1)
+        if len(flat) > self.chunk:  # constructor sizing guarantees this fits
+            raise ValueError(
+                f"spec drafts payload {len(flat)} exceeds packet slot "
+                f"{self.chunk}; size ControlPlane for n_lanes*SPEC_DRAFT"
+            )
+        self._send(
+            OP_DECODE_SPEC, 0, n, 0,
+            tokens, positions,
+            np.asarray(temps, np.float32).view(np.int32),
+            np.asarray(topps, np.float32).view(np.int32),
+            np.asarray(seeds, np.uint32).view(np.int32),
+            flat,
+            np.asarray(draft_len, np.int32),
         )
 
     def send_stop(self) -> None:
@@ -194,22 +222,38 @@ class RootControlEngine:
             )
         return out
 
-    def decode(self, tokens, positions, temps=None, topps=None, seeds=None):
-        # normalize sampling args HERE so the packet and the root's engine
-        # call carry byte-identical values (workers replay from the packet)
+    def _normalize_sampling(self, temps, topps, seeds):
+        """Packet and root-side engine call must carry byte-identical
+        sampling values (workers replay from the packet) — one place owns
+        the defaults for every op type."""
         n = self._engine.n_lanes
-        temps = np.zeros(n, np.float32) if temps is None else np.asarray(temps, np.float32)
-        topps = np.full(n, 0.9, np.float32) if topps is None else np.asarray(topps, np.float32)
-        seeds = np.zeros(n, np.uint32) if seeds is None else np.asarray(seeds, np.uint32)
+        return (
+            np.zeros(n, np.float32) if temps is None else np.asarray(temps, np.float32),
+            np.full(n, 0.9, np.float32) if topps is None else np.asarray(topps, np.float32),
+            np.zeros(n, np.uint32) if seeds is None else np.asarray(seeds, np.uint32),
+        )
+
+    def decode(self, tokens, positions, temps=None, topps=None, seeds=None):
+        temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode(
             np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
             temps, topps, seeds,
         )
         return self._engine.decode(tokens, positions, temps, topps, seeds)
 
-    # speculative decode is a different compiled program; the control plane
-    # does not broadcast it, so pods run plain decode (scheduler checks this)
-    supports_speculative = False
+    def decode_spec(
+        self, tokens, drafts, draft_len, positions,
+        temps=None, topps=None, seeds=None,
+    ):
+        temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._plane.send_decode_spec(
+            np.asarray(tokens, np.int32), np.asarray(drafts, np.int32),
+            np.asarray(draft_len, np.int32), np.asarray(positions, np.int32),
+            temps, topps, seeds,
+        )
+        return self._engine.decode_spec(
+            tokens, drafts, draft_len, positions, temps, topps, seeds
+        )
 
     def measured_sync_stats(self, steps: int = 4) -> dict:
         """Disabled on pod roots: the probe's direct decode calls would not
@@ -245,6 +289,17 @@ def worker_loop(engine, plane: ControlPlane) -> None:
         elif op == OP_DECODE:
             engine.decode(
                 plane.slot(pkt, 0, n),
+                plane.slot(pkt, 1, n),
+                plane.slot(pkt, 2, n).view(np.float32),
+                plane.slot(pkt, 3, n).view(np.float32),
+                plane.slot(pkt, 4, n).view(np.uint32),
+            )
+        elif op == OP_DECODE_SPEC:
+            k = engine.SPEC_DRAFT
+            engine.decode_spec(
+                plane.slot(pkt, 0, n),
+                plane.slot(pkt, 5, n * k).reshape(n, k),
+                plane.slot(pkt, 6, n),
                 plane.slot(pkt, 1, n),
                 plane.slot(pkt, 2, n).view(np.float32),
                 plane.slot(pkt, 3, n).view(np.float32),

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from ..models.config import LlamaConfig
 from ..models.llama import KVCache, LlamaParams, init_kv_cache, llama_forward
+from .spec import SPEC_DRAFT
 
 DEFAULT_PREFILL_BUCKETS = (16, 64, 256, 1024)
 
@@ -403,8 +404,10 @@ class InferenceEngine:
         return logits, greedy_np, sampled_np
 
     # drafts per speculative step (K = SPEC_DRAFT + 1 verified tokens)
-    SPEC_DRAFT = 3
-    supports_speculative = True  # RootControlEngine overrides to False
+    SPEC_DRAFT = SPEC_DRAFT
+    # pod roots forward this via RootControlEngine.__getattr__ and broadcast
+    # verify steps as OP_DECODE_SPEC control packets
+    supports_speculative = True
 
     def decode_spec(
         self,

@@ -14,6 +14,10 @@ identity: greedy output streams are exactly the plain-decode streams.
 
 from __future__ import annotations
 
+# drafts per speculative step (K = SPEC_DRAFT + 1 verified tokens); shared
+# by the engine's verify program and the control plane's packet sizing
+SPEC_DRAFT = 3
+
 
 class NgramDraftIndex:
     """Committed token history + n-gram -> last-start-position index."""

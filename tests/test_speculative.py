@@ -158,10 +158,42 @@ def test_scheduler_spec_near_seq_len(loaded):
     assert len(out) >= 1
 
 
-def test_pod_root_engine_disables_spec():
+def test_pod_root_engine_broadcasts_spec():
+    """RootControlEngine supports speculation by broadcasting an
+    OP_DECODE_SPEC packet before the root-side verify call, so workers
+    replay the identical program (no silent direct dispatch)."""
     from distributed_llama_multiusers_tpu.parallel.multihost import (
+        OP_DECODE_SPEC,
+        ControlPlane,
         RootControlEngine,
     )
 
-    assert RootControlEngine.supports_speculative is False
     assert InferenceEngine.supports_speculative is True
+
+    sent = []
+
+    class _Plane(ControlPlane):
+        def _bcast(self, pkt):
+            sent.append(np.array(pkt))
+            return pkt
+
+    class _Inner:
+        n_lanes = 2
+        SPEC_DRAFT = 3
+        supports_speculative = True
+
+        def decode_spec(self, tokens, drafts, draft_len, positions,
+                        temps=None, topps=None, seeds=None):
+            return "logits", np.zeros((2, 4), np.int32), np.ones(2, np.int32)
+
+    plane = _Plane(n_lanes=2, chunk=8)
+    root = RootControlEngine(_Inner(), plane)
+    assert root.supports_speculative  # forwarded from the inner engine
+    tokens = np.array([1, 2], np.int32)
+    drafts = np.array([[3, 4, 5], [6, 7, 8]], np.int32)
+    dlen = np.array([3, 0], np.int32)
+    root.decode_spec(tokens, drafts, dlen, tokens)
+    assert len(sent) == 1 and sent[0][0] == OP_DECODE_SPEC
+    # the worker-side decode reconstructs the drafts from slots 5/6
+    assert list(plane.slot(sent[0], 5, 6)) == [3, 4, 5, 6, 7, 8]
+    assert list(plane.slot(sent[0], 6, 2)) == [3, 0]
