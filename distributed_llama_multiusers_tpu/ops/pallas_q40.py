@@ -55,8 +55,15 @@ from ..quants.packed import (
     pallas_wide_tile as _pick_w,
 )
 
-SINGLE_SLAB_BYTES = 1 << 20  # planes up to this: one DMA, no k axis
-TARGET_BLOCK_BYTES = 1 << 20  # k-chunk size target (DMA/compute overlap)
+import os as _os
+
+# tuning knobs, env-overridable for hardware sweeps (scripts/kernel_sweep.py)
+SINGLE_SLAB_BYTES = int(
+    _os.environ.get("DLLAMA_SINGLE_SLAB", 1 << 20)
+)  # planes up to this: one DMA, no k axis
+TARGET_BLOCK_BYTES = int(
+    _os.environ.get("DLLAMA_TARGET_BLOCK", 1 << 20)
+)  # k-chunk size target (DMA/compute overlap)
 M_TILE = 256
 ROW_ALIGN = 8  # x rows padded to this multiple
 

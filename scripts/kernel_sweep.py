@@ -1,0 +1,69 @@
+"""Sweep the slab-kernel tuning knobs on real hardware.
+
+Runs `BENCH_CHILD=1 BENCH_PHASE=primary python bench.py` in a child process
+per configuration (the knobs are read at module import, so each combo needs
+a fresh interpreter) and reports decode tok/s + hbm_util per combo.
+
+Run: python scripts/kernel_sweep.py [timeout_per_combo_s]
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+COMBOS = {
+    # (single-slab ceiling, k-chunk target) in bytes
+    "slab1M_blk1M": (1 << 20, 1 << 20),
+    "slab2M_blk2M": (2 << 20, 2 << 20),
+    "slab4M_blk2M": (4 << 20, 2 << 20),
+    "slab4M_blk4M": (4 << 20, 4 << 20),
+    "slab512k_blk512k": (512 << 10, 512 << 10),
+}
+
+
+def main():
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = {}
+    for name, (slab, blk) in COMBOS.items():
+        env = dict(
+            os.environ,
+            BENCH_CHILD="1",
+            BENCH_PHASE="primary",
+            DLLAMA_SINGLE_SLAB=str(slab),
+            DLLAMA_TARGET_BLOCK=str(blk),
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(repo, "bench.py")],
+                capture_output=True, text=True, timeout=budget, env=env,
+                cwd=repo,
+            )
+            line = next(
+                (ln for ln in reversed(proc.stdout.strip().splitlines())
+                 if ln.startswith("{")),
+                None,
+            )
+            rec = json.loads(line) if line else {"error": proc.stderr[-200:]}
+        except subprocess.TimeoutExpired:
+            rec = {"error": f"timeout {budget:.0f}s"}
+        results[name] = rec
+        print(f"{name:20s} tok/s={rec.get('value')} "
+              f"hbm={rec.get('hbm_util')} err={rec.get('error', '')[:80]}",
+              flush=True)
+    best = max(
+        (r for r in results.items() if r[1].get("value")),
+        key=lambda kv: kv[1]["value"],
+        default=None,
+    )
+    if best:
+        print(f"BEST: {best[0]} -> {best[1]['value']} tok/s "
+              f"(hbm_util {best[1].get('hbm_util')})")
+
+
+if __name__ == "__main__":
+    main()
