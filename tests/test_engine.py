@@ -220,3 +220,31 @@ def test_empty_prompt_fails_cleanly(stack):
         assert "empty prompt" in str(e.value) or "at least one token" in str(e.value)
     finally:
         sched.stop()
+
+
+def test_warmup_engine_compiles_without_polluting_stats(tiny_model):
+    """warmup_engine pre-compiles every serving program (prefill buckets,
+    decode, spec verify) and restores the stats counters, so a warmed
+    engine reports zero steps until real traffic arrives."""
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.formats import load_model_header
+    from distributed_llama_multiusers_tpu.models import load_params_from_m
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.runtime.engine import warmup_engine
+
+    h = load_model_header(tiny_model["model"])
+    config, params = load_params_from_m(tiny_model["model"], h, dtype=jnp.float32)
+    engine = InferenceEngine(config, params, n_lanes=2, prefill_buckets=(4, 8))
+    warmup_engine(engine)
+    assert engine.stats.decode_steps == 0
+    assert engine.stats.prefill_tokens == 0
+    assert engine.stats.spec_steps == 0
+    # the warmed programs still serve real traffic correctly
+    _, greedy, pos = engine.prefill(0, [5, 9, 3])
+    assert pos == 3
+    import numpy as np
+
+    _, g2, _ = engine.decode(np.zeros(2, np.int32), np.full(2, pos, np.int32))
+    assert g2.shape == (2,)
+    assert engine.stats.decode_steps == 1
