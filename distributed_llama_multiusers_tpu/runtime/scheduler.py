@@ -179,6 +179,13 @@ class ContinuousBatchingScheduler:
     def _free_lane_indices(self) -> list[int]:
         return [i for i, l in enumerate(self._lanes) if l.request is None]
 
+    def occupancy(self) -> tuple[int, int]:
+        """(busy lanes, total lanes) — public surface for /stats."""
+        return (
+            sum(1 for l in self._lanes if l.request is not None),
+            len(self._lanes),
+        )
+
     def _admit(self) -> None:
         free = self._free_lane_indices()
         while free:

@@ -90,6 +90,29 @@ class ApiServer:
     def handle_models(self) -> dict:
         return api_types.models_response(self.model_name)
 
+    def handle_stats(self) -> dict:
+        """Serving metrics (beyond reference parity — SURVEY §5.5 notes it
+        has no metrics endpoint): engine counters plus scheduler occupancy."""
+        sched = self.scheduler
+        stats = sched.engine.stats
+        busy, total = sched.occupancy()
+        spec_steps = stats.spec_steps
+        return {
+            "prefill_tokens": stats.prefill_tokens,
+            "prefill_s": round(stats.prefill_s, 3),
+            "decode_steps": stats.decode_steps,
+            "decode_s": round(stats.decode_s, 3),
+            "host_bytes_in": stats.host_bytes_in,
+            "spec_steps": spec_steps,
+            "spec_emitted": stats.spec_emitted,
+            "spec_tokens_per_step": (
+                round(stats.spec_emitted / spec_steps, 3) if spec_steps else None
+            ),
+            "sync_bytes_per_decode": stats.sync_bytes_per_decode,
+            "lanes_total": total,
+            "lanes_busy": busy,
+        }
+
     # -- plumbing -----------------------------------------------------------
 
     def serve(self, host: str = "0.0.0.0", port: int = 9990) -> ThreadingHTTPServer:
@@ -124,6 +147,8 @@ class ApiServer:
             def do_GET(self):
                 if self.path == "/v1/models":
                     self._json(200, api.handle_models())
+                elif self.path == "/stats":
+                    self._json(200, api.handle_stats())
                 elif self.path in ("/", "/health"):
                     self._json(200, {"status": "ok", "model": api.model_name})
                 else:

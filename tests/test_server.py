@@ -150,3 +150,20 @@ def test_streaming_bad_request_gets_400(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=30)
     assert e.value.code == 400
+
+
+def test_stats_endpoint(server):
+    """GET /stats reports engine counters + lane occupancy (beyond reference
+    parity: the reference has no metrics endpoint, SURVEY §5.5)."""
+    # generate something first so counters are non-zero
+    post(
+        server + "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 3,
+         "temperature": 0},
+    )
+    with urllib.request.urlopen(server + "/stats", timeout=30) as r:
+        body = json.loads(r.read())
+    assert body["decode_steps"] >= 1
+    assert body["lanes_total"] >= 1
+    assert 0 <= body["lanes_busy"] <= body["lanes_total"]
+    assert "spec_tokens_per_step" in body
