@@ -51,3 +51,21 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
             plugins.add("tpu")
     except ImportError:
         pass
+
+
+def greedy_rollout(engine, prompt, n):
+    """Plain greedy decode of n tokens on lane 0 (other lanes idle);
+    returns (produced tokens, final position). Shared by the speculative-
+    decoding tests and the multichip dryrun's on-mesh acceptance check."""
+    import numpy as np
+
+    _, g, pos = engine.prefill(0, prompt)
+    toks = [int(g)]
+    tokens = np.zeros(engine.n_lanes, np.int32)
+    positions = np.zeros(engine.n_lanes, np.int32)
+    for _ in range(n - 1):
+        tokens[0], positions[0] = toks[-1], pos
+        _, greedy, _ = engine.decode(tokens, positions)
+        toks.append(int(greedy[0]))
+        pos += 1
+    return toks, pos

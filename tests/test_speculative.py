@@ -37,15 +37,9 @@ def _fresh_engine(config, params, n_lanes=2):
 
 def _greedy_rollout(engine, prompt, n):
     """Plain greedy decode of n tokens on lane 0; returns produced tokens."""
-    _, g, pos = engine.prefill(0, prompt)
-    toks = [int(g)]
-    tokens = np.zeros(engine.n_lanes, np.int32)
-    positions = np.zeros(engine.n_lanes, np.int32)
-    for _ in range(n - 1):
-        tokens[0], positions[0] = toks[-1], pos
-        _, greedy, _ = engine.decode(tokens, positions)
-        toks.append(int(greedy[0]))
-        pos += 1
+    from distributed_llama_multiusers_tpu.utils.testing import greedy_rollout
+
+    toks, _ = greedy_rollout(engine, prompt, n)
     return toks
 
 
