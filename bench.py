@@ -502,7 +502,12 @@ def _run_child(env_extra: dict, timeout_s: float):
         parsed = _last_json_line(_text(e.stdout))
         if parsed is not None:
             return parsed, None
-        return None, f"timeout after {timeout_s:.0f}s; stderr tail: {_text(e.stderr)[-300:]}"
+        err = f"timeout after {timeout_s:.0f}s; stderr tail: {_text(e.stderr)[-300:]}"
+        if "[bench] backend up" not in _text(e.stderr):
+            # the device tunnel never initialized: retrying burns the whole
+            # deadline on another hang — callers should fall back instead
+            err = "NO_BACKEND " + err
+        return None, err
     parsed = _last_json_line(proc.stdout)
     if parsed is not None:
         if proc.returncode != 0:
@@ -528,7 +533,10 @@ def main() -> None:
 
     # -- primary metric first, retried: nothing else runs until it banks ----
     for attempt in range(2):
-        budget = min(600.0, deadline - time.monotonic())
+        # 360 s is generous for the primary phase alone (~90 s observed on
+        # hardware incl. param gen); capping it keeps a hung device tunnel
+        # from eating the whole deadline before the CPU fallback
+        budget = min(360.0, deadline - time.monotonic())
         if budget < 120:
             break
         result, err = _run_child({"BENCH_PHASE": "primary"}, budget)
@@ -538,6 +546,8 @@ def main() -> None:
             break
         errors.append(f"primary[{attempt}]: {err}")
         print(f"[bench-watchdog] {errors[-1]}", file=sys.stderr, flush=True)
+        if err and err.startswith("NO_BACKEND"):
+            break  # dead tunnel: spend the remaining budget on CPU fallback
         if attempt < 1:
             time.sleep(15)
 
