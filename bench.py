@@ -19,6 +19,8 @@ single phase can eat the budget:
   ablations  — packed Q40 via XLA dequant, dense bf16 (what the kernel buys)
   8b         — the BASELINE north star: Llama-3.1-8B Q40 decode tok/s vs
                200 tok/s/chip (BASELINE.md), now on by default
+  parity     — greedy token-identity of the shipping bf16-dot kernel vs
+               exact f32 over 256 tokens (BASELINE.md gate-dtype clause)
 
 Perf-path hygiene: weights are generated DIRECTLY as random packed planes
 (no 2.5-16 GB dense intermediate on the host), so the first measurement
@@ -26,7 +28,10 @@ lands within a couple of minutes even over a slow device tunnel.
 
 vs_baseline: ratio against the reference's best published single-device
 number — Llama 2 7B on 1x RPi 4B at 1312.50 ms/token = 0.762 tok/s
-(report.pdf Fig. 3, BASELINE.md).
+(report.pdf Fig. 3, BASELINE.md). Reported ONLY for TPU runs (null on the
+CPU fallback: a 1B-on-CPU vs 7B-on-RPi ratio is not a comparison), and
+overwritten with the matched-model Llama-3.1-8B ratio when the 8b phase
+lands; vs_baseline_model names the pairing.
 """
 
 from __future__ import annotations
@@ -285,7 +290,18 @@ def _phase_primary(config, platform, device_kind, small):
         "metric": METRIC,
         "value": round(tok_s, 2),
         "unit": "tok/s",
-        "vs_baseline": round(tok_s / REFERENCE_SINGLE_DEVICE_TOK_S, 2),
+        # ratio only for TPU runs (a CPU-fallback 1B number vs the
+        # reference's 7B-on-RPi invites misreading — round-4 weak #8); the
+        # 8b phase overwrites this with the matched-model ratio when it
+        # lands (see main)
+        "vs_baseline": (
+            round(tok_s / REFERENCE_SINGLE_DEVICE_TOK_S, 2)
+            if platform == "tpu" else None
+        ),
+        "vs_baseline_model": (
+            "llama32_1b (this) vs llama2_7b on 1x RPi 4B (reference)"
+            if platform == "tpu" else None
+        ),
         "platform": platform,
         "device_kind": str(device_kind),
         "weight_read_gb_s": round(weight_bytes * tok_s / 1e9, 1),
@@ -327,6 +343,16 @@ def _phase_serving(config, small):
 
     engine.decode = timed_decode
 
+    real_spec = engine.decode_spec
+
+    def timed_spec(*a, **k):
+        t0 = time.perf_counter()
+        out = real_spec(*a, **k)
+        step_times.append(time.perf_counter() - t0)
+        return out
+
+    engine.decode_spec = timed_spec
+
     tokenizer = _BenchTokenizer(config.vocab_size)
     sched = ContinuousBatchingScheduler(engine, tokenizer)
 
@@ -356,13 +382,25 @@ def _phase_serving(config, small):
 
     run_batch()  # compile + warmup (prefill bucket + decode programs)
     step_times.clear()
+    engine.stats.reset()  # spec counters must cover the measured batch only
     toks, wall = run_batch()
     lat = np.sort(np.asarray(step_times))
+    stats = engine.stats
     return {
         "serving_tok_s_8lanes": round(toks / wall, 2),
         "serving_step_ms_p50": round(float(lat[len(lat) // 2]) * 1e3, 2),
         "serving_step_ms_p95": round(float(lat[int(len(lat) * 0.95)]) * 1e3, 2),
         "serving_requests": n_lanes,
+        # speculation acceptance over the measured batch, per (lane,
+        # verify-step): 1.0 = every lane-step emitted only its own token
+        # (no draft accepted), K+1 = full acceptance. spec_emitted counts
+        # tokens across ALL lanes, so it is normalized by lane-steps, not
+        # by batched verify calls.
+        "serving_spec_steps": stats.spec_steps,
+        "spec_tokens_per_lane_step": (
+            round(stats.spec_emitted / stats.spec_lane_steps, 2)
+            if stats.spec_lane_steps else None
+        ),
     }
 
 
@@ -429,6 +467,50 @@ def _phase_8b(platform):
     }
 
 
+def _phase_parity(config, platform):
+    """BASELINE.md's token-identity gate, measured with the SHIPPING TPU
+    dtype: greedy-decode 256 tokens with the default bf16-dot kernel and
+    with exact f32 (set_pallas_w_dtype), same synthetic Q40 weights, and
+    report whether the streams are token-identical — plus the first
+    divergence step if not. Random weights have near-zero logit margins,
+    so a divergence here is the worst case, not the real-model rate; the
+    interpret-mode CI test (tests/test_pallas_q40.py) pins model-scale
+    identity."""
+    if platform != "tpu":
+        return {"token_parity_bf16": None,
+                "parity_note": f"skipped off-TPU ({platform})"}
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.ops import linear
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.utils.testing import greedy_rollout
+
+    params = jax.tree.map(jax.device_put, _random_packed_params(config))
+    prompt = list(range(1, 17))
+    n = 256
+    streams = {}
+    for name, wd in (("bf16", jnp.bfloat16), ("f32", jnp.float32)):
+        linear.set_pallas_w_dtype(wd)
+        try:
+            engine = InferenceEngine(
+                config, params, n_lanes=1, prefill_buckets=(16,)
+            )
+            toks, _ = greedy_rollout(engine, prompt, n)
+        finally:
+            linear.set_pallas_w_dtype(None)
+        streams[name] = toks
+        del engine
+    mism = [i for i, (a, b) in enumerate(zip(streams["bf16"], streams["f32"]))
+            if a != b]
+    return {
+        "token_parity_bf16": not mism,
+        "parity_tokens": n,
+        "parity_first_divergence": mism[0] if mism else None,
+        "parity_divergent_steps": len(mism),
+    }
+
+
 def child_main() -> None:
     # CPU runs must strip the TPU PJRT plugin BEFORE backend discovery: this
     # box's sitecustomize registers one whose init dials a network tunnel,
@@ -461,6 +543,8 @@ def child_main() -> None:
         result = _phase_ablations(config, small)
     elif phase == "8b":
         result = _phase_8b(platform)
+    elif phase == "parity":
+        result = _phase_parity(config, platform)
     else:
         raise ValueError(f"unknown BENCH_PHASE {phase!r}")
     print(json.dumps(result), flush=True)
@@ -575,7 +659,10 @@ def main() -> None:
     extra_env = (
         {"BENCH_FORCE_CPU": "1", "GRAFT_SMALL": "1"} if force_cpu else {}
     )
-    for phase, cap in (("serving", 420.0), ("8b", 500.0), ("ablations", 420.0)):
+    for phase, cap in (
+        ("serving", 420.0), ("8b", 500.0), ("ablations", 420.0),
+        ("parity", 300.0),
+    ):
         budget = min(cap, deadline - time.monotonic() - 10)
         if budget < 90:
             errors.append(f"{phase}: skipped (out of budget)")
@@ -586,6 +673,17 @@ def main() -> None:
         else:
             errors.append(f"{phase}: {err}")
             print(f"[bench-watchdog] {errors[-1]}", file=sys.stderr, flush=True)
+
+    # matched-model headline ratio: once the 8B north star lands on TPU,
+    # compare it (not the 1B primary) against the reference's published 7B
+    # number — the closest model-for-model comparison available
+    eight_b = merged.get("llama31_8b_q40_decode_tok_s")
+    if eight_b and merged.get("platform") == "tpu":
+        merged["vs_baseline"] = round(eight_b / REFERENCE_SINGLE_DEVICE_TOK_S, 2)
+        merged["vs_baseline_model"] = (
+            "llama31_8b_q40 (this, 1 TPU chip) vs llama2_7b_q40 "
+            "(reference, 1x RPi 4B, report.pdf Fig.3)"
+        )
 
     if errors:
         merged["phase_errors"] = "; ".join(errors)[-600:]

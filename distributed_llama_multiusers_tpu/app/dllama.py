@@ -52,25 +52,19 @@ def run_inference(args) -> None:
                 f"  Sync {cstats['total_bytes'] / 1024:8.1f} kB/chip"
                 f" ({cstats['n_collectives']} collectives)"
             )
-    # idle lanes beyond 0 are harmless (multi-host roots run max_lanes lanes
-    # so every process compiles identical decode shapes)
-    toks = np.zeros(engine.n_lanes, np.int32)
-    poss = np.zeros(engine.n_lanes, np.int32)
     # prompt-lookup speculation for greedy runs (exact-stream identity; the
-    # scheduler has the multi-lane version — this is the single-stream one)
-    spec_k = getattr(engine, "SPEC_DRAFT", 0)
-    use_spec = (
-        args.temperature == 0.0
-        and spec_k > 0
-        and getattr(engine, "supports_speculative", False)
-        and not getattr(args, "no_spec", False)
-    )
-    drafter = None
-    if use_spec:
-        from ..runtime.spec import NgramDraftIndex
+    # scheduler has the multi-lane version — SpecStream is the single-stream
+    # one, shared with chat mode)
+    from ..runtime.spec import SpecStream
 
-        drafter = NgramDraftIndex(tokens)
-    pending: list[int] = []  # produced-but-not-yet-emitted spec lookahead
+    spec = SpecStream(
+        engine,
+        config,
+        enabled=(
+            args.temperature == 0.0 and not getattr(args, "no_spec", False)
+        ),
+        prompt_tokens=tokens,
+    )
     for _ in range(args.steps):
         piece = tokenizer.decode(cur)
         if piece:
@@ -78,44 +72,20 @@ def run_inference(args) -> None:
             print(piece, end="", flush=True)
         if tokenizer.is_eos(cur) or pos >= config.seq_len:
             break
-        if pending:
+        t1 = time.perf_counter()
+        nxt, used_forward = spec.advance(cur, pos)
+        if not used_forward:
             # cur's cache write already happened in the spec step
-            if drafter is not None:
-                drafter.append(cur)
             pos += 1
             pred_times.append(0.0)  # token count for the tok/s summary
-            cur = pending.pop(0)
+            cur = nxt
             continue
-        draft = (
-            drafter.draft(cur, spec_k)
-            if use_spec and pos + spec_k + 1 <= config.seq_len
-            else []
-        )
-        if drafter is not None:
-            drafter.append(cur)
-        toks[0] = cur
-        poss[0] = pos
-        t1 = time.perf_counter()
-        if draft:
-            drafts = np.zeros((engine.n_lanes, spec_k), np.int32)
-            dlen = np.zeros(engine.n_lanes, np.int32)
-            drafts[0, : len(draft)] = draft
-            dlen[0] = len(draft)
-            _, em, ne = engine.decode_spec(toks, drafts, dlen, poss)
-            cnt = int(ne[0])
-            seq = [int(t) for t in em[0, :cnt]]
-            nxt, pending = seq[0], seq[1:]
-        else:
-            logits_b, greedy_b, _ = engine.decode(toks, poss)
-            nxt = (
-                int(greedy_b[0])
-                if args.temperature == 0.0
-                else sampler.sample(engine.lane_logits(logits_b, 0))
-            )
+        if args.temperature > 0.0:
+            nxt = sampler.sample(engine.lane_logits(spec.last_logits, 0))
         dt = time.perf_counter() - t1
         pred_times.append(dt)
         if args.benchmark:
-            spec_note = f"  (spec +{len(pending)})" if pending else ""
+            spec_note = f"  (spec +{len(spec.pending)})" if spec.pending else ""
             log("🔶", f"Pred {dt * 1000:8.2f} ms{sync_suffix}{spec_note}")
         pos += 1
         cur = nxt
@@ -149,6 +119,18 @@ def run_chat(args) -> None:
     generator = chat_generator_for(tokenizer, args.chat_template)
     stops = TokenizerChatStops(tokenizer)
     sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or int(time.time()))
+    # greedy chat gets the same prompt-lookup speculation as inference mode
+    # — the interactive path is where per-token latency is most visible,
+    # and chat output (code, lists, repeated names) drafts well
+    from ..runtime.spec import SpecStream
+
+    spec = SpecStream(
+        engine,
+        config,
+        enabled=(
+            args.temperature == 0.0 and not getattr(args, "no_spec", False)
+        ),
+    )
 
     pos = 0
     first = True
@@ -172,13 +154,12 @@ def run_chat(args) -> None:
         if pos + len(tokens) >= config.seq_len:
             log("🚫", "Context window full")
             return
+        spec.extend_history(tokens)
         logits, greedy, pos = engine.prefill(0, tokens, start_pos=pos)
         cur = greedy if args.temperature == 0.0 else sampler.sample(np.asarray(logits))
 
         detector = EosDetector(tokenizer.eos_token_ids, stops.stops, 2, 2)
         decoder = tokenizer.make_stream_decoder()
-        toks = np.zeros(engine.n_lanes, np.int32)
-        poss = np.zeros(engine.n_lanes, np.int32)
         while pos < config.seq_len:
             piece = decoder.decode(cur)
             result = detector.append(cur, piece)
@@ -192,11 +173,15 @@ def run_chat(args) -> None:
                 if delta:
                     print(delta, end="", flush=True)
                 detector.reset()
-            toks[0] = cur
-            poss[0] = pos
-            logits_b, greedy_b, _ = engine.decode(toks, poss)
+            nxt, used_forward = spec.advance(cur, pos)
+            if used_forward and args.temperature > 0.0:
+                nxt = sampler.sample(engine.lane_logits(spec.last_logits, 0))
             pos += 1
-            cur = int(greedy_b[0]) if args.temperature == 0.0 else sampler.sample(engine.lane_logits(logits_b, 0))
+            cur = nxt
+        # spec lookahead past EOS is uncommitted cache scribble; the next
+        # turn's prefill overwrites it from pos, so only the host-side
+        # buffer needs clearing
+        spec.pending.clear()
         print()
 
 

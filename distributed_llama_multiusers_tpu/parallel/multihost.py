@@ -29,6 +29,7 @@ OP_STOP = 0
 OP_PREFILL = 1
 OP_DECODE = 2
 OP_DECODE_SPEC = 3
+OP_STATS_RESET = 4  # zero worker-side engine counters (post-warmup hygiene)
 
 
 def maybe_initialize_distributed(args=None) -> int:
@@ -159,6 +160,9 @@ class ControlPlane:
     def send_stop(self) -> None:
         self._send(OP_STOP, 0, 0, 0)
 
+    def send_stats_reset(self) -> None:
+        self._send(OP_STATS_RESET, 0, 0, 0)
+
     def recv(self) -> np.ndarray:
         return self._bcast(np.zeros(self._size, np.int32))
 
@@ -266,12 +270,20 @@ class RootControlEngine:
     def stop_workers(self) -> None:
         self._plane.send_stop()
 
+    def reset_worker_stats(self) -> None:
+        """Broadcast a stats reset so worker counters drop warmup traffic
+        (the root restores its own via ``stats.preserved()``)."""
+        self._plane.send_stats_reset()
 
-def worker_loop(engine, plane: ControlPlane) -> None:
+
+def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
     """Replay root-broadcast engine calls until OP_STOP — the SPMD twin of
     runWorkerApp's poll-forward loop (src/app.cpp:405-464). Every process
     (root included, via RootControlEngine) executes the same compiled steps
-    in the same order, so the global-mesh collectives line up."""
+    in the same order, so the global-mesh collectives line up.
+
+    ``on_replay`` (if given) is called after each successfully replayed
+    packet — ``worker_serve`` uses it to refresh its restart budget."""
     while True:
         pkt = plane.recv()
         op, lane, n, start_pos = (int(x) for x in pkt[:4])
@@ -305,12 +317,18 @@ def worker_loop(engine, plane: ControlPlane) -> None:
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
             )
+        elif op == OP_STATS_RESET:
+            # warmup traffic must not pollute worker-side counters either
+            # (the root restores its own via stats.preserved())
+            engine.stats.reset()
         else:
             raise ValueError(f"unknown control op {op}")
+        if on_replay is not None:
+            on_replay()
 
 
 def worker_serve(engine, plane: ControlPlane, max_restarts: int | None = 3,
-                 log=print) -> None:
+                 healthy_window: int = 64, log=print) -> None:
     """Supervised worker: re-enter ``worker_loop`` after a replay error — the
     analogue of runWorkerApp's outer loop, which catches exceptions and
     re-``serve()``s instead of exiting (src/app.cpp:455-463). A worker that
@@ -323,13 +341,30 @@ def worker_serve(engine, plane: ControlPlane, max_restarts: int | None = 3,
     a worker that retries forever would turn that into a silent hang instead
     of a process death that jax.distributed's peer-failure detection surfaces.
     Bounded retries absorb pre-dispatch failures (the common, recoverable
-    kind) while still crashing out of a persistent desync."""
+    kind) while still crashing out of a persistent desync.
+
+    The budget is a SLIDING WINDOW, not a lifetime total: after
+    ``healthy_window`` consecutive successful replays the restart counter
+    resets, so a long-lived worker absorbing an occasional transient error
+    re-serves indefinitely like the reference's outer loop — while a
+    persistent error (or a tight burst, the desync signature) still
+    exhausts the budget within one window and raises."""
     restarts = 0
+    healthy = 0
+
+    def _replayed() -> None:
+        nonlocal restarts, healthy
+        healthy += 1
+        if healthy >= healthy_window:
+            restarts = 0
+            healthy = 0
+
     while True:
         try:
-            worker_loop(engine, plane)
+            worker_loop(engine, plane, on_replay=_replayed)
             return
         except Exception as e:  # noqa: BLE001 — supervised restart boundary
+            healthy = 0
             restarts += 1
             log(f"worker replay error (restart {restarts}): {e!r}")
             if max_restarts is not None and restarts > max_restarts:

@@ -137,6 +137,9 @@ def pallas_wide_tile(d_out: int) -> int | None:
     return None
 
 
+PAD_MAX_OVERHEAD = 0.125  # never inflate a tensor's bytes by more than this
+
+
 def pad_packed_d_out(packed: np.ndarray, scales: np.ndarray):
     """Zero-pad a packed weight's OUTPUT dim to a multiple of 8192 when the
     slab kernel cannot tile it WELL (e.g. vocab 128256: best natural tile
@@ -144,12 +147,26 @@ def pad_packed_d_out(packed: np.ndarray, scales: np.ndarray):
     slabs for +2.2% bytes). Only valid for output-only tensors (wcls):
     consumers must slice the matmul result back to the true width
     (llama_forward slices logits to vocab_size). Zero scales make the pad
-    columns exact zeros."""
+    columns exact zeros.
+
+    Padding is capped at PAD_MAX_OVERHEAD of the tensor's bytes: an
+    unlucky width like 8320 would round to 16384 (+97%), which costs more
+    HBM than the wide tile saves — those widths keep their natural layout
+    and take the narrow-tile or q40_matmul_xla path instead. Pads that do
+    land are logged so the inflation is visible."""
     d_out = packed.shape[-1]
     tile = pallas_wide_tile(d_out)
     if d_out <= PALLAS_W_MAX or (tile is not None and tile >= 4096):
         return packed, scales
     pad = -d_out % PALLAS_W_MAX
+    if pad > d_out * PAD_MAX_OVERHEAD:
+        return packed, scales
+    import logging
+
+    logging.getLogger(__name__).info(
+        "padding packed d_out %d -> %d (+%.1f%% bytes) for wide slab tiles",
+        d_out, d_out + pad, 100.0 * pad / d_out,
+    )
     width = [(0, 0)] * (packed.ndim - 1) + [(0, pad)]
     return (
         np.pad(np.asarray(packed), width),

@@ -46,8 +46,11 @@ class EngineStats:
     prefill_tokens: int = 0
     decode_steps: int = 0
     host_bytes_in: int = 0  # device->host logits/token traffic
-    spec_steps: int = 0  # speculative verify steps
-    spec_emitted: int = 0  # tokens emitted by spec steps (>= spec_steps)
+    spec_steps: int = 0  # speculative verify steps (one per batched call)
+    # maintained by the consuming loops (scheduler / SpecStream), since the
+    # engine cannot know how many verified tokens the caller commits:
+    spec_emitted: int = 0  # tokens emitted via spec steps, all lanes
+    spec_lane_steps: int = 0  # (lane, spec-step) pairs that consumed tokens
     # estimated per-step collective payload (bytes/chip), from the compiled
     # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
     sync_bytes_per_decode: int = 0
@@ -57,6 +60,8 @@ class EngineStats:
         snap = EngineStats(**self.__dict__)
         self.prefill_s = self.decode_s = 0.0
         self.prefill_tokens = self.decode_steps = self.host_bytes_in = 0
+        self.spec_steps = self.spec_emitted = self.spec_lane_steps = 0
+        # sync_* stay: they describe the compiled program, not a window
         return snap
 
     def preserved(self):
@@ -208,8 +213,11 @@ class InferenceEngine:
             accepted prefix stay uncommitted (per-lane pos only advances by
             what the scheduler consumes) and are rewritten before any query
             can read them — the same invariant chunked prefill relies on.
-            The scheduler must keep pos + K <= seq_len (it falls back to
-            plain decode near the end of a lane's sequence)."""
+            Writes at positions >= seq_len are dropped by the cache scatter
+            (mode="drop"), so lanes near the end of their sequence are safe
+            as long as the caller clamps that lane's draft_len to
+            seq_len - pos - 1 (emitted token t reads logits at pos + t,
+            which needs in-bounds KV through pos + t)."""
             full = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [n, K]
             k_spec = full.shape[1]
             pos2d = positions[:, None] + jnp.arange(k_spec, dtype=jnp.int32)
@@ -426,9 +434,11 @@ class InferenceEngine:
         stream exactly (speculative-verification identity); temp>0 lanes
         must pass draft_len 0 and emit one fused-sampled token.
 
-        Caller contract: positions[i] + SPEC_DRAFT + 1 <= seq_len for every
-        lane (use plain ``decode`` otherwise). Returns (step_logits
-        [n, vocab] device array, emitted np[n, K], n_emit np[n])."""
+        Caller contract (per lane): draft_len[i] <= seq_len - positions[i]
+        - 1, so every emitted token's logits row has in-bounds KV behind it;
+        overshooting draft-slot KV writes are dropped by the cache scatter.
+        Returns (step_logits [n, vocab] device array, emitted np[n, K],
+        n_emit np[n])."""
         n = self.n_lanes
         if temps is None:
             temps = np.zeros(n, np.float32)
@@ -565,3 +575,7 @@ def warmup_engine(engine, spec: bool = True) -> None:
             engine.decode_spec(
                 z, np.zeros((n, engine.SPEC_DRAFT), np.int32), z, z
             )
+    # pod roots: drop the replayed warmup traffic from worker counters too
+    reset_workers = getattr(engine, "reset_worker_stats", None)
+    if reset_workers is not None:
+        reset_workers()

@@ -382,26 +382,26 @@ class ContinuousBatchingScheduler:
                     topps[i] = lane.request.topp
                     seeds[i] = lane.seed
 
-            # speculative step (prompt-lookup drafts, greedy lanes): only
-            # when every occupied lane has K uncommitted cache slots left —
-            # near seq_len the draft scribbles could clobber committed state,
-            # so those steps fall back to plain decode
+            # speculative step (prompt-lookup drafts, greedy lanes), gated
+            # PER LANE: each lane drafts at most the uncommitted cache slots
+            # it has left before seq_len (emitting m tokens reads logits at
+            # pos..pos+m-1, which need in-bounds KV writes; writes at
+            # >= seq_len are dropped by the cache scatter, so a lane at the
+            # end of its sequence cannot clobber state or disable drafting
+            # on other lanes)
             spec_k = getattr(self.engine, "SPEC_DRAFT", 0)
             draft_len = None
             if (
                 self.speculative
                 and spec_k > 0
                 and getattr(self.engine, "supports_speculative", False)
-                and all(
-                    l.request is None or l.pos + spec_k + 1 <= cfg.seq_len
-                    for l in self._lanes
-                )
             ):
                 drafts = np.zeros((n_lanes, spec_k), np.int32)
                 draft_len = np.zeros(n_lanes, np.int32)
                 for i, lane in active:
-                    if lane.request.temperature == 0.0:
-                        d = lane.drafter.draft(lane.next_token, spec_k)
+                    d_max = min(spec_k, cfg.seq_len - lane.pos - 1)
+                    if lane.request.temperature == 0.0 and d_max > 0:
+                        d = lane.drafter.draft(lane.next_token, spec_k)[:d_max]
                         drafts[i, : len(d)] = d
                         draft_len[i] = len(d)
                 if not draft_len.any():
@@ -432,6 +432,7 @@ class ContinuousBatchingScheduler:
                     # equal the greedy continuations, so this is exactly the
                     # plain-decode token stream); the model's token after
                     # the accepted prefix becomes the new pending token
+                    self.engine.stats.spec_lane_steps += 1
                     cnt = int(n_emit[i])
                     seq = [lane.next_token] + [
                         int(t) for t in emitted[i, : cnt - 1]

@@ -51,3 +51,78 @@ class NgramDraftIndex:
                 if cont:
                     return cont
         return []
+
+
+class SpecStream:
+    """Single-stream speculative decode for the CLIs (inference AND chat):
+    prompt-lookup drafts plus a pending-lookahead buffer, so greedy runs
+    emit >1 token per forward when drafts hit while keeping the exact
+    plain-decode token stream (speculative-verification identity).
+
+    Per-stream analogue of the scheduler's per-lane spec path; near
+    seq_len the draft length is clamped to the slots left (the cache
+    scatter drops overshooting writes — models/llama.py KV append)."""
+
+    def __init__(self, engine, config, enabled: bool, prompt_tokens=()):
+        import numpy as np
+
+        self.engine = engine
+        self.config = config
+        self.spec_k = getattr(engine, "SPEC_DRAFT", 0)
+        self.enabled = (
+            enabled
+            and self.spec_k > 0
+            and getattr(engine, "supports_speculative", False)
+        )
+        self.drafter = NgramDraftIndex(prompt_tokens) if self.enabled else None
+        self.pending: list[int] = []  # produced-but-not-yet-emitted lookahead
+        self._toks = np.zeros(engine.n_lanes, np.int32)
+        self._poss = np.zeros(engine.n_lanes, np.int32)
+        self.last_logits = None  # batch logits of the last real forward
+
+    def extend_history(self, tokens) -> None:
+        """Feed non-generated tokens (chat-turn prompts) to the draft index."""
+        if self.drafter is not None:
+            for t in tokens:
+                self.drafter.append(int(t))
+
+    def advance(self, cur: int, pos: int):
+        """Commit ``cur`` at ``pos`` and return ``(next_token, used_forward)``.
+        used_forward=False means the token came from the pending lookahead
+        (its cache write already happened in the spec step that drafted it).
+        For temperature>0 callers (spec disabled), sample from
+        ``last_logits`` instead of the returned greedy token."""
+        import numpy as np
+
+        if self.pending:
+            if self.drafter is not None:
+                self.drafter.append(cur)
+            return self.pending.pop(0), False
+        draft: list[int] = []
+        if self.drafter is not None:
+            d_max = min(self.spec_k, self.config.seq_len - pos - 1)
+            if d_max > 0:
+                draft = self.drafter.draft(cur, self.spec_k)[:d_max]
+            self.drafter.append(cur)
+        self._toks[0] = cur
+        self._poss[0] = pos
+        if draft:
+            drafts = np.zeros((self.engine.n_lanes, self.spec_k), np.int32)
+            dlen = np.zeros(self.engine.n_lanes, np.int32)
+            drafts[0, : len(draft)] = draft
+            dlen[0] = len(draft)
+            _, em, ne = self.engine.decode_spec(
+                self._toks, drafts, dlen, self._poss
+            )
+            seq = [int(t) for t in em[0, : int(ne[0])]]
+            self.pending = seq[1:]
+            # same acceptance accounting as the scheduler's consume loop,
+            # so engine-level stats stay meaningful for CLI runs too
+            stats = getattr(self.engine, "stats", None)
+            if stats is not None:
+                stats.spec_lane_steps += 1
+                stats.spec_emitted += len(seq)
+            return seq[0], True
+        logits_b, greedy_b, _ = self.engine.decode(self._toks, self._poss)
+        self.last_logits = logits_b
+        return int(greedy_b[0]), True

@@ -105,8 +105,13 @@ class ApiServer:
             "host_bytes_in": stats.host_bytes_in,
             "spec_steps": spec_steps,
             "spec_emitted": stats.spec_emitted,
-            "spec_tokens_per_step": (
-                round(stats.spec_emitted / spec_steps, 3) if spec_steps else None
+            "spec_lane_steps": stats.spec_lane_steps,
+            # acceptance per (lane, verify-step): 1.0 = no draft accepted,
+            # K+1 = full acceptance. Normalized by lane-steps because
+            # spec_emitted counts tokens across all lanes of a batched call.
+            "spec_tokens_per_lane_step": (
+                round(stats.spec_emitted / stats.spec_lane_steps, 3)
+                if stats.spec_lane_steps else None
             ),
             "sync_bytes_per_decode": stats.sync_bytes_per_decode,
             "lanes_total": total,

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Probe the axon TPU tunnel until it answers, then exit 0.
+# Each probe is a short-lived child with a hard timeout so a hung backend
+# init can't wedge the watcher. Status appended to scripts/tpu_watch.log.
+LOG="$(cd "$(dirname "$0")" && pwd)/tpu_watch.log"
+DEADLINE=$(( $(date +%s) + ${TPU_WATCH_MAX_S:-39600} ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout "${TPU_PROBE_TIMEOUT_S:-90}" python - <<'EOF' >>"$LOG" 2>&1
+import jax, time
+t0 = time.time()
+d = jax.devices()
+print(f"ALIVE {time.strftime('%F %T')} init={time.time()-t0:.1f}s devices={d}")
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+(x @ x).block_until_ready()
+print(f"MATMUL-OK {time.time()-t0:.1f}s")
+EOF
+  then
+    echo "TPU ALIVE at $(date)" >>"$LOG"
+    exit 0
+  fi
+  echo "probe dead at $(date)" >>"$LOG"
+  sleep "${TPU_PROBE_INTERVAL_S:-240}"
+done
+echo "watcher gave up at $(date)" >>"$LOG"
+exit 1

@@ -248,3 +248,19 @@ def test_warmup_engine_compiles_without_polluting_stats(tiny_model):
     _, g2, _ = engine.decode(np.zeros(2, np.int32), np.full(2, pos, np.int32))
     assert g2.shape == (2,)
     assert engine.stats.decode_steps == 1
+
+
+def test_stats_reset_zeroes_spec_counters():
+    """reset() must clear the speculation counters with the rest of the
+    window (round-4 advisor finding: delta consumers saw stale totals)."""
+    from distributed_llama_multiusers_tpu.runtime.engine import EngineStats
+
+    s = EngineStats()
+    s.decode_steps = 5
+    s.spec_steps = 3
+    s.spec_emitted = 9
+    s.sync_bytes_per_decode = 1024  # program property: survives reset
+    snap = s.reset()
+    assert (snap.spec_steps, snap.spec_emitted) == (3, 9)
+    assert (s.spec_steps, s.spec_emitted, s.decode_steps) == (0, 0, 0)
+    assert s.sync_bytes_per_decode == 1024

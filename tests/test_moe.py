@@ -301,3 +301,73 @@ def test_moe_serving_with_speculation(moe_model, tmp_path):
         return list(r.generated_tokens)
 
     assert run(True) == run(False)
+
+
+def test_moe_packed_single_shard_prefill_flops_scale_with_k(monkeypatch):
+    """Round-4 weak #3: single-shard PackedQ40 experts took a Python loop
+    over all E experts (FLOPs ∝ E) for EVERY step shape. Now only
+    decode-shaped steps (token count below MOE_PACKED_SPARSE_MIN_TOKENS,
+    where the loop is bytes-optimal) keep the dequant-in-matmul loop;
+    prefill/training-shaped steps dequantize each expert once and take the
+    grouped ragged_dot dispatch — per-token expert compute ∝ k. Both paths
+    must agree numerically."""
+    from distributed_llama_multiusers_tpu.models import llama as llama_mod
+    from distributed_llama_multiusers_tpu.ops import linear
+
+    config = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=1, n_heads=4, n_kv_heads=2,
+        vocab_size=96, seq_len=64, n_experts=4, n_active_experts=2,
+    )
+    params = params_from_random(config, seed=2, dtype=jnp.float32, to_device=False)
+    q = jax.tree.map(jnp.asarray, quantize_params(params, to_device=False))
+
+    def ragged_dots(t_len, b=1):
+        tokens = jnp.zeros((b, t_len), jnp.int32)
+        positions = jnp.broadcast_to(
+            jnp.arange(t_len, dtype=jnp.int32)[None, :], (b, t_len)
+        )
+        jaxpr = jax.make_jaxpr(
+            lambda p, c: llama_forward(config, p, tokens, positions, c)
+        )(q, init_kv_cache(config, b))
+        hits = []
+
+        def walk(jx):
+            for eqn in jx.eqns:
+                if eqn.primitive.name.startswith("ragged_dot"):
+                    hits.append(eqn.invars[0].aval.shape[0])
+                for v in eqn.params.values():
+                    if hasattr(v, "jaxpr"):
+                        walk(v.jaxpr)
+
+        walk(jaxpr.jaxpr)
+        return hits
+
+    linear.set_pallas_interpret(True)
+    try:
+        t_big = llama_mod.MOE_PACKED_SPARSE_MIN_TOKENS
+        assert ragged_dots(1) == []  # decode-shaped: per-expert loop
+        # speculative verify (T=K=4) at 8 lanes is decode-shaped too: the
+        # gate reads T, not B*T, so a full spec batch stays on the
+        # bandwidth-bound packed loop (code-review finding, round 5)
+        assert ragged_dots(4, b=8) == []
+        # prefill-shaped: 3 grouped matmuls of T*k rows per layer
+        assert ragged_dots(t_big) == [t_big * 2] * 3
+
+        # numeric parity: grouped dispatch vs the per-expert loop on the
+        # same packed weights and tokens
+        tokens = jnp.asarray([[5, 9, 21, 3] * (t_big // 4)], jnp.int32)
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+        sparse, _ = llama_forward(
+            config, q, tokens, positions, init_kv_cache(config, 1)
+        )
+        monkeypatch.setattr(
+            llama_mod, "MOE_PACKED_SPARSE_MIN_TOKENS", 10**9
+        )
+        loop, _ = llama_forward(
+            config, q, tokens, positions, init_kv_cache(config, 1)
+        )
+    finally:
+        linear.set_pallas_interpret(False)
+    np.testing.assert_allclose(
+        np.asarray(sparse), np.asarray(loop), atol=1e-4, rtol=1e-4
+    )

@@ -257,3 +257,101 @@ def test_two_process_pod_scheduler_sampled_matches_mesh(tmp_path):
     assert req.error is None, req.error
 
     assert pod_tokens == req.generated_tokens
+
+
+class _ScriptedPlane:
+    """In-process stand-in for ControlPlane: serves a scripted packet list
+    (no broadcast, no pod) so worker_serve's restart policy is testable in
+    milliseconds."""
+
+    HEADER = 4
+
+    def __init__(self, ops, chunk=8):
+        self.chunk = chunk
+        self._pkts = [self._pkt(op) for op in ops]
+
+    def _pkt(self, op):
+        import numpy as np
+
+        pkt = np.zeros(self.HEADER + 7 * self.chunk, np.int32)
+        pkt[0:4] = (op, 0, 2, 0)
+        return pkt
+
+    def recv(self):
+        return self._pkts.pop(0)
+
+    def slot(self, pkt, i, n):
+        start = self.HEADER + i * self.chunk
+        return pkt[start : start + n]
+
+
+class _ScriptedEngine:
+    """decode() raises on the scripted call indices (1-based)."""
+
+    SPEC_DRAFT = 3
+
+    def __init__(self, fail_on=()):
+        self.calls = 0
+        self.fail_on = set(fail_on)
+
+    def decode(self, *a):
+        self.calls += 1
+        if self.calls in self.fail_on:
+            raise RuntimeError(f"transient #{self.calls}")
+
+
+def test_worker_serve_budget_refreshes_after_healthy_window():
+    """Three transient errors spread over a long replay stream survive a
+    max_restarts=2 budget because healthy_window replays refresh it — the
+    reference worker re-serves indefinitely (src/app.cpp:405-464); the old
+    lifetime counter would have died on the third error."""
+    from distributed_llama_multiusers_tpu.parallel.multihost import (
+        OP_DECODE, OP_STOP, worker_serve,
+    )
+
+    fail_on = {4, 8, 12}  # each preceded by >= 3 healthy replays
+    ops = [OP_DECODE] * 13 + [OP_STOP]
+    engine = _ScriptedEngine(fail_on)
+    worker_serve(
+        engine, _ScriptedPlane(ops), max_restarts=2, healthy_window=3,
+        log=lambda m: None,
+    )
+    assert engine.calls == 13  # every packet replayed, worker exited on stop
+
+
+def test_worker_serve_persistent_error_still_raises():
+    """A persistent error (every replay fails — the desync signature)
+    exhausts the budget within one window and raises."""
+    import pytest
+
+    from distributed_llama_multiusers_tpu.parallel.multihost import (
+        OP_DECODE, worker_serve,
+    )
+
+    engine = _ScriptedEngine(fail_on=set(range(1, 100)))
+    with pytest.raises(RuntimeError, match="transient"):
+        worker_serve(
+            engine, _ScriptedPlane([OP_DECODE] * 20), max_restarts=2,
+            healthy_window=3, log=lambda m: None,
+        )
+    assert engine.calls == 3  # restarts 1..3 > max_restarts=2
+
+
+def test_stats_reset_op_clears_worker_counters():
+    """OP_STATS_RESET replays as engine.stats.reset() so pod workers drop
+    warmup traffic from their counters (the root restores its own via
+    stats.preserved())."""
+    from distributed_llama_multiusers_tpu.parallel.multihost import (
+        OP_STATS_RESET, OP_STOP, worker_loop,
+    )
+    from distributed_llama_multiusers_tpu.runtime.engine import EngineStats
+
+    class _Eng(_ScriptedEngine):
+        stats = EngineStats()
+
+    engine = _Eng()
+    engine.stats.decode_steps = 7
+    engine.stats.spec_steps = 2
+    worker_loop(engine, _ScriptedPlane([OP_STATS_RESET, OP_STOP]))
+    assert engine.stats.decode_steps == 0
+    assert engine.stats.spec_steps == 0

@@ -243,3 +243,27 @@ def test_q80_sync_matmul_parity_and_payload_drop():
     assert q80["total_bytes"] < 0.8 * base["total_bytes"], (base, q80)
     # the int8 gather must be visible in the mix
     assert any(k.startswith("all-gather") for k in q80["bytes_by_kind"]), q80
+
+
+def test_pad_packed_d_out_caps_overhead():
+    """Padding to wide slabs is only worth it when cheap: vocab-like widths
+    (128256 -> 131072, +2.2%) pad; unlucky widths whose next 8192 multiple
+    nearly doubles the bytes (8320 -> 16384) keep their natural layout and
+    take the narrow-tile/XLA path instead (round-4 advisor finding)."""
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.quants.packed import (
+        PAD_MAX_OVERHEAD, pad_packed_d_out,
+    )
+
+    def fake(d_out, d_in=64):
+        packed = np.zeros((d_in // 2, d_out), np.uint8)
+        scales = np.zeros((d_in // 32, d_out), np.float16)
+        return packed, scales
+
+    pk, sc = pad_packed_d_out(*fake(128256))
+    assert pk.shape[-1] == 131072 and sc.shape[-1] == 131072
+
+    pk, sc = pad_packed_d_out(*fake(8320))  # +97% > cap: unchanged
+    assert pk.shape[-1] == 8320 and sc.shape[-1] == 8320
+    assert 8192 * 2 - 8320 > 8320 * PAD_MAX_OVERHEAD  # the case is real

@@ -166,4 +166,5 @@ def test_stats_endpoint(server):
     assert body["decode_steps"] >= 1
     assert body["lanes_total"] >= 1
     assert 0 <= body["lanes_busy"] <= body["lanes_total"]
-    assert "spec_tokens_per_step" in body
+    assert "spec_tokens_per_lane_step" in body
+    assert "spec_lane_steps" in body

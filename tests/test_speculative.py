@@ -191,3 +191,91 @@ def test_pod_root_engine_broadcasts_spec():
     # the worker-side decode reconstructs the drafts from slots 5/6
     assert list(plane.slot(sent[0], 5, 6)) == [3, 4, 5, 6, 7, 8]
     assert list(plane.slot(sent[0], 6, 2)) == [3, 0]
+
+
+def test_scheduler_spec_gates_per_lane(loaded):
+    """A lane near seq_len must NOT disable speculation for the whole
+    batch (round-4 weak #4: the old global all() gate did): while lane 0
+    sits within SPEC_DRAFT slots of seq_len, other lanes keep drafting,
+    and lane 0's own drafts are clamped to its remaining slots."""
+    config, params, tok = loaded
+    k = InferenceEngine.SPEC_DRAFT
+    # a prompt that prefills lane 0 to within k slots of seq_len (old gate
+    # territory: pos + k + 1 > seq_len) while still allowed to generate;
+    # the synthetic tokenizer is char-level (one token per char + BOS)
+    long_prompt = "a" * (config.seq_len - 3)
+    n_long = len(tok.encode(long_prompt))
+    assert config.seq_len - k <= n_long <= config.seq_len - 2, (
+        f"long prompt landed at {n_long} tokens; expected within "
+        f"[{config.seq_len - k}, {config.seq_len - 2}]"
+    )
+
+    def reqs():
+        return [
+            Request(prompt=long_prompt, max_tokens=8, temperature=0.0),
+            Request(prompt="aa bb aa bb aa bb aa bb aa", max_tokens=50,
+                    temperature=0.0),
+        ]
+
+    engine = _fresh_engine(config, params, n_lanes=2)
+    calls = []
+    real = engine.decode_spec
+
+    def spy(tokens, drafts, draft_len, positions, *a, **kw):
+        calls.append((np.array(positions), np.array(draft_len)))
+        return real(tokens, drafts, draft_len, positions, *a, **kw)
+
+    engine.decode_spec = spy
+    got_spec = _run_requests(engine, tok, reqs())
+
+    near_end = [
+        (pos, dlen) for pos, dlen in calls if pos[0] >= config.seq_len - k
+    ]
+    assert near_end, "no spec step ran while lane 0 was near seq_len"
+    for pos, dlen in calls:
+        for lane in range(2):
+            assert dlen[lane] <= max(0, config.seq_len - pos[lane] - 1)
+    assert any(dlen[1] > 0 for _, dlen in near_end), (
+        "lane 1 stopped drafting while lane 0 was near seq_len"
+    )
+
+    # clamped partial drafts keep the exact plain-decode streams
+    import unittest.mock as mock
+
+    plain_engine = _fresh_engine(config, params, n_lanes=2)
+    with mock.patch.object(
+        type(plain_engine), "supports_speculative", False
+    ):
+        got_plain = _run_requests(plain_engine, tok, reqs())
+    assert got_spec == got_plain
+
+
+def test_spec_stream_emits_plain_stream_with_fewer_forwards(loaded):
+    """SpecStream (the single-stream helper behind inference AND chat
+    mode) emits exactly the plain greedy stream while spending fewer
+    forwards on draftable output; near seq_len it clamps instead of
+    overshooting."""
+    from distributed_llama_multiusers_tpu.runtime.spec import SpecStream
+
+    config, params, tok = loaded
+    prompt = tok.encode("aa bb aa bb aa bb aa bb")
+    n = 40
+
+    ref_engine = _fresh_engine(config, params, n_lanes=1)
+    ref = _greedy_rollout(ref_engine, prompt, n)
+
+    engine = _fresh_engine(config, params, n_lanes=1)
+    _, g0, pos = engine.prefill(0, prompt)
+    spec = SpecStream(engine, config, enabled=True, prompt_tokens=prompt)
+    cur, out, forwards = int(g0), [int(g0)], 0
+    while len(out) < n and pos < config.seq_len - 1:
+        nxt, used_forward = spec.advance(cur, pos)
+        forwards += used_forward
+        pos += 1
+        cur = nxt
+        out.append(cur)
+    assert out == ref[: len(out)]
+    assert forwards < len(out) - 1, (
+        f"speculation never accepted a draft ({forwards} forwards for "
+        f"{len(out)} tokens on repetitive output)"
+    )
