@@ -391,11 +391,10 @@ def _phase_serving(config, small):
         "serving_step_ms_p50": round(float(lat[len(lat) // 2]) * 1e3, 2),
         "serving_step_ms_p95": round(float(lat[int(len(lat) * 0.95)]) * 1e3, 2),
         "serving_requests": n_lanes,
-        # speculation acceptance over the measured batch, per (lane,
-        # verify-step): 1.0 = every lane-step emitted only its own token
-        # (no draft accepted), K+1 = full acceptance. spec_emitted counts
-        # tokens across ALL lanes, so it is normalized by lane-steps, not
-        # by batched verify calls.
+        # speculation acceptance over the measured batch, per (DRAFTED
+        # lane, verify-step): 1.0 = no draft accepted, K+1 = full
+        # acceptance. Sampled/draft-less lanes are excluded from both
+        # counters, so the ratio is undiluted acceptance.
         "serving_spec_steps": stats.spec_steps,
         "spec_tokens_per_lane_step": (
             round(stats.spec_emitted / stats.spec_lane_steps, 2)
@@ -659,9 +658,12 @@ def main() -> None:
     extra_env = (
         {"BENCH_FORCE_CPU": "1", "GRAFT_SMALL": "1"} if force_cpu else {}
     )
+    # priority order under a shared deadline = the round-4 verdict's:
+    # serving numbers, the 8B north star, the bf16 parity gate, then the
+    # ablation diagnostics (the sweep below runs with whatever is left)
     for phase, cap in (
-        ("serving", 420.0), ("8b", 500.0), ("ablations", 420.0),
-        ("parity", 300.0),
+        ("serving", 420.0), ("8b", 500.0), ("parity", 300.0),
+        ("ablations", 420.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)
         if budget < 90:
@@ -673,6 +675,60 @@ def main() -> None:
         else:
             errors.append(f"{phase}: {err}")
             print(f"[bench-watchdog] {errors[-1]}", file=sys.stderr, flush=True)
+
+    # -- kernel-knob sweep, TPU only: A/B the slab kernel's DMA geometry ----
+    # (round-4 verdict #1: the sweep harness existed but never produced a
+    # datapoint; running it inside the bench banks the A/B even when the
+    # tunnel only comes back for the driver's round-end run). Each combo is
+    # a fresh primary child (the knobs are read at module import); if one
+    # beats the default headline by >2%, the headline adopts it and records
+    # the knobs.
+    if merged.get("platform") == "tpu":
+        from distributed_llama_multiusers_tpu.ops.pallas_q40 import (
+            DEFAULT_COMBO,
+            SWEEP_COMBOS,
+        )
+
+        sweep: dict = {}
+        combos = [
+            (n, s, b) for n, (s, b) in SWEEP_COMBOS.items()
+            if n != DEFAULT_COMBO
+        ][:3]
+        for name, slab, blk in combos:
+            budget = min(300.0, deadline - time.monotonic() - 10)
+            if budget < 90:
+                errors.append("sweep: skipped (out of budget)")
+                break
+            result, err = _run_child(
+                {"BENCH_PHASE": "primary",
+                 "DLLAMA_SINGLE_SLAB": str(slab),
+                 "DLLAMA_TARGET_BLOCK": str(blk)},
+                budget,
+            )
+            if result is not None and result.get("value"):
+                sweep[name] = {
+                    k: result.get(k)
+                    for k in ("value", "hbm_util", "weight_read_gb_s")
+                }
+                if result["value"] > (merged.get("value") or 0) * 1.02:
+                    merged.update({
+                        k: result[k]
+                        for k in ("value", "hbm_util", "weight_read_gb_s", "mfu")
+                        if k in result
+                    })
+                    merged["kernel_knobs"] = name
+                    # keep the headline ratio consistent with the adopted
+                    # value (the 8b matched-model overwrite below may still
+                    # supersede it)
+                    merged["vs_baseline"] = round(
+                        result["value"] / REFERENCE_SINGLE_DEVICE_TOK_S, 2
+                    )
+            else:
+                errors.append(f"sweep[{name}]: {err}")
+                if err and err.startswith("NO_BACKEND"):
+                    break  # tunnel died mid-sweep: stop burning budget
+        if sweep:
+            bank({"kernel_sweep": sweep})
 
     # matched-model headline ratio: once the 8B north star lands on TPU,
     # compare it (not the 1B primary) against the reference's published 7B

@@ -49,8 +49,12 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--nthreads", type=int, default=1)
     p.add_argument("--max-lanes", type=int, default=8, help="concurrent request lanes (continuous batching)")
-    p.add_argument("--kv-dtype", default="auto", choices=["auto", "bf16", "f32"],
-                   help="KV cache dtype: auto = bf16 on TPU (half the HBM), f32 on CPU")
+    p.add_argument("--kv-dtype", default="auto",
+                   choices=["auto", "bf16", "f32", "f8"],
+                   help="KV cache dtype: auto = bf16 on TPU (half the HBM), "
+                        "f32 on CPU; f8 = float8_e4m3 storage (quarter the "
+                        "f32 HBM — double the lanes or context per chip; "
+                        "dequant fuses into the attention reads)")
     p.add_argument("--chat-template", default=None, choices=[None, "llama2", "llama3", "deepSeek3"])
     p.add_argument("--workers", nargs="*", default=None,
                    help="TPU: device count or mesh spec (dp2,tp4); reference compat")

@@ -133,10 +133,12 @@ def load_stack(args, n_lanes: int | None = None):
         # from --max-lanes on all hosts (n_lanes overrides are single-host)
         n_lanes=(n_lanes if n_proc == 1 else None) or args.max_lanes,
         # None -> bf16 KV on TPU, f32 on CPU (parity oracle); --kv-dtype
-        # overrides (e.g. f32 on TPU for strict-parity serving)
-        cache_dtype={"f32": jnp.float32, "bf16": jnp.bfloat16, "auto": None}[
-            getattr(args, "kv_dtype", "auto") or "auto"
-        ],
+        # overrides (e.g. f32 on TPU for strict-parity serving, f8 for
+        # double the lanes/context per chip)
+        cache_dtype={
+            "f32": jnp.float32, "bf16": jnp.bfloat16,
+            "f8": jnp.float8_e4m3fn, "auto": None,
+        }[getattr(args, "kv_dtype", "auto") or "auto"],
         emulate_q80_activations=emulate_q80,
         q80_sync=q80_sync,
         mesh=mesh,

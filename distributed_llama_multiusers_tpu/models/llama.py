@@ -78,6 +78,17 @@ def init_kv_cache(config: LlamaConfig, n_lanes: int, dtype=jnp.float32) -> KVCac
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
+def _to_cache_dtype(x: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Cast fresh K/V rows to the cache storage dtype. float8_e4m3 (the
+    quarter-footprint serving option, --kv-dtype f8) has no inf: saturate
+    at its finite max so a rare activation outlier degrades to clipping
+    instead of NaN-poisoning the lane's cache."""
+    if dtype == jnp.float8_e4m3fn:
+        lim = float(jnp.finfo(dtype).max)
+        x = jnp.clip(x, -lim, lim)
+    return x.astype(dtype)
+
+
 def _qdq_q80(x: jnp.ndarray) -> jnp.ndarray:
     """Quantize-dequantize through Q80 blocks — emulates the reference's
     F32->Q80 casts (src/nn/nn-quants.cpp:154-172) via the shared JAX codec."""
@@ -341,10 +352,10 @@ def llama_forward(
         # overshooting draft slots nowhere, so per-lane spec gating needs no
         # global barrier (scheduler._run's per-lane d_max relies on this).
         k_cache = k_cache.at[lane_idx, positions].set(
-            k.astype(k_cache.dtype), mode="drop"
+            _to_cache_dtype(k, k_cache.dtype), mode="drop"
         )
         v_cache = v_cache.at[lane_idx, positions].set(
-            v.astype(v_cache.dtype), mode="drop"
+            _to_cache_dtype(v, v_cache.dtype), mode="drop"
         )
 
         # GQA attention in f32 (reference multiheadAtt_F32, nn-cpu-ops.cpp:749-784)

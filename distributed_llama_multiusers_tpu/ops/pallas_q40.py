@@ -64,6 +64,20 @@ SINGLE_SLAB_BYTES = int(
 TARGET_BLOCK_BYTES = int(
     _os.environ.get("DLLAMA_TARGET_BLOCK", 1 << 20)
 )  # k-chunk size target (DMA/compute overlap)
+
+# The one shared DMA-geometry sweep table: (single-slab ceiling, k-chunk
+# target) in bytes, keyed by a stable name. scripts/kernel_sweep.py runs
+# all of them; bench.py's in-bench sweep runs the non-default entries in
+# this order under its remaining deadline. Ordered best-candidates-first
+# (round-4 stage_probe pointed at larger contiguous DMAs).
+SWEEP_COMBOS = {
+    "slab1M_blk1M": (1 << 20, 1 << 20),  # the compiled-in default above
+    "slab2M_blk2M": (2 << 20, 2 << 20),
+    "slab4M_blk2M": (4 << 20, 2 << 20),
+    "slab512k_blk512k": (512 << 10, 512 << 10),
+    "slab4M_blk4M": (4 << 20, 4 << 20),
+}
+DEFAULT_COMBO = "slab1M_blk1M"
 M_TILE = 256
 ROW_ALIGN = 8  # x rows padded to this multiple
 

@@ -48,9 +48,11 @@ class EngineStats:
     host_bytes_in: int = 0  # device->host logits/token traffic
     spec_steps: int = 0  # speculative verify steps (one per batched call)
     # maintained by the consuming loops (scheduler / SpecStream), since the
-    # engine cannot know how many verified tokens the caller commits:
-    spec_emitted: int = 0  # tokens emitted via spec steps, all lanes
-    spec_lane_steps: int = 0  # (lane, spec-step) pairs that consumed tokens
+    # engine cannot know how many verified tokens the caller commits.
+    # DRAFTED lanes only (draft_len > 0), consumed tokens only — so
+    # emitted/lane_steps reads as acceptance in [1, K+1]:
+    spec_emitted: int = 0  # tokens consumed from spec steps, drafted lanes
+    spec_lane_steps: int = 0  # (drafted lane, spec-step) pairs
     # estimated per-step collective payload (bytes/chip), from the compiled
     # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
     sync_bytes_per_decode: int = 0

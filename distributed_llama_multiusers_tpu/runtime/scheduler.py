@@ -431,15 +431,21 @@ class ContinuousBatchingScheduler:
                     # feed sequence: next_token + the accepted drafts (they
                     # equal the greedy continuations, so this is exactly the
                     # plain-decode token stream); the model's token after
-                    # the accepted prefix becomes the new pending token
-                    self.engine.stats.spec_lane_steps += 1
+                    # the accepted prefix becomes the new pending token.
+                    # Acceptance counters cover DRAFTED lanes only — sampled
+                    # and draft-less lanes ride the same batched verify call
+                    # but always emit 1, which would dilute the metric
+                    drafted = int(draft_len[i]) > 0
+                    if drafted:
+                        self.engine.stats.spec_lane_steps += 1
                     cnt = int(n_emit[i])
                     seq = [lane.next_token] + [
                         int(t) for t in emitted[i, : cnt - 1]
                     ]
                     alive = True
                     for t in seq:
-                        self.engine.stats.spec_emitted += 1  # consumed only
+                        if drafted:
+                            self.engine.stats.spec_emitted += 1  # consumed
                         if not self._consume(i, lane, t):
                             alive = False
                             break

@@ -97,6 +97,9 @@ class SpecStream:
         if self.pending:
             if self.drafter is not None:
                 self.drafter.append(cur)
+            stats = getattr(self.engine, "stats", None)
+            if stats is not None:
+                stats.spec_emitted += 1  # lookahead token consumed NOW
             return self.pending.pop(0), False
         draft: list[int] = []
         if self.drafter is not None:
@@ -116,12 +119,13 @@ class SpecStream:
             )
             seq = [int(t) for t in em[0, : int(ne[0])]]
             self.pending = seq[1:]
-            # same acceptance accounting as the scheduler's consume loop,
-            # so engine-level stats stay meaningful for CLI runs too
+            # consumed-only accounting, same semantics as the scheduler's
+            # loop: the tokens still in `pending` count when popped (and
+            # never count if a turn ends and discards them)
             stats = getattr(self.engine, "stats", None)
             if stats is not None:
                 stats.spec_lane_steps += 1
-                stats.spec_emitted += len(seq)
+                stats.spec_emitted += 1  # seq[0], consumed now
             return seq[0], True
         logits_b, greedy_b, _ = self.engine.decode(self._toks, self._poss)
         self.last_logits = logits_b

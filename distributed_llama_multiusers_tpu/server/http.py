@@ -106,9 +106,9 @@ class ApiServer:
             "spec_steps": spec_steps,
             "spec_emitted": stats.spec_emitted,
             "spec_lane_steps": stats.spec_lane_steps,
-            # acceptance per (lane, verify-step): 1.0 = no draft accepted,
-            # K+1 = full acceptance. Normalized by lane-steps because
-            # spec_emitted counts tokens across all lanes of a batched call.
+            # acceptance per (DRAFTED lane, verify-step): 1.0 = no draft
+            # accepted, K+1 = full acceptance. Sampled/draft-less lanes ride
+            # the same batched call but are excluded from both counters.
             "spec_tokens_per_lane_step": (
                 round(stats.spec_emitted / stats.spec_lane_steps, 3)
                 if stats.spec_lane_steps else None

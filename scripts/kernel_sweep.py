@@ -9,20 +9,16 @@ Run: python scripts/kernel_sweep.py [timeout_per_combo_s]
 
 from __future__ import annotations
 
-import itertools
 import json
 import os
 import subprocess
 import sys
 
-COMBOS = {
-    # (single-slab ceiling, k-chunk target) in bytes
-    "slab1M_blk1M": (1 << 20, 1 << 20),
-    "slab2M_blk2M": (2 << 20, 2 << 20),
-    "slab4M_blk2M": (4 << 20, 2 << 20),
-    "slab4M_blk4M": (4 << 20, 4 << 20),
-    "slab512k_blk512k": (512 << 10, 512 << 10),
-}
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_llama_multiusers_tpu.ops.pallas_q40 import (  # noqa: E402
+    SWEEP_COMBOS as COMBOS,  # the one shared DMA-geometry table
+)
 
 
 def main():
