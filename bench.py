@@ -690,10 +690,13 @@ def main() -> None:
         )
 
         sweep: dict = {}
-        combos = [
+        non_default = [
             (n, s, b) for n, (s, b) in SWEEP_COMBOS.items()
             if n != DEFAULT_COMBO
-        ][:3]
+        ]
+        combos = non_default[:3]
+        for n, _, _ in non_default[3:]:  # no silent caps
+            errors.append(f"sweep[{n}]: skipped (combo cap)")
         for name, slab, blk in combos:
             budget = min(300.0, deadline - time.monotonic() - 10)
             if budget < 90:

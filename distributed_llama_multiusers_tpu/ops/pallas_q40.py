@@ -74,8 +74,8 @@ SWEEP_COMBOS = {
     "slab1M_blk1M": (1 << 20, 1 << 20),  # the compiled-in default above
     "slab2M_blk2M": (2 << 20, 2 << 20),
     "slab4M_blk2M": (4 << 20, 2 << 20),
-    "slab512k_blk512k": (512 << 10, 512 << 10),
     "slab4M_blk4M": (4 << 20, 4 << 20),
+    "slab512k_blk512k": (512 << 10, 512 << 10),
 }
 DEFAULT_COMBO = "slab1M_blk1M"
 M_TILE = 256

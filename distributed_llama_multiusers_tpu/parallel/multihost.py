@@ -30,6 +30,7 @@ OP_PREFILL = 1
 OP_DECODE = 2
 OP_DECODE_SPEC = 3
 OP_STATS_RESET = 4  # zero worker-side engine counters (post-warmup hygiene)
+OP_COPY_LANE = 5  # prefix caching: copy one lane's KV into another
 
 
 def maybe_initialize_distributed(args=None) -> int:
@@ -163,6 +164,10 @@ class ControlPlane:
     def send_stats_reset(self) -> None:
         self._send(OP_STATS_RESET, 0, 0, 0)
 
+    def send_copy_lane(self, src: int, dst: int) -> None:
+        # header fields carry the operands: lane=src, start_pos=dst
+        self._send(OP_COPY_LANE, src, 0, dst)
+
     def recv(self) -> np.ndarray:
         return self._bcast(np.zeros(self._size, np.int32))
 
@@ -275,6 +280,16 @@ class RootControlEngine:
         (the root restores its own via ``stats.preserved()``)."""
         self._plane.send_stats_reset()
 
+    def copy_lane(self, src: int, dst: int) -> None:
+        """Prefix caching on a pod: every process must dispatch the same
+        cache-copy program (the cache is sharded over the global mesh), so
+        the operands ride a control packet before the root-side call —
+        __getattr__ forwarding alone would desync the workers."""
+        if src == dst:
+            return
+        self._plane.send_copy_lane(src, dst)
+        self._engine.copy_lane(src, dst)
+
 
 def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
     """Replay root-broadcast engine calls until OP_STOP — the SPMD twin of
@@ -321,6 +336,8 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
             # warmup traffic must not pollute worker-side counters either
             # (the root restores its own via stats.preserved())
             engine.stats.reset()
+        elif op == OP_COPY_LANE:
+            engine.copy_lane(lane, start_pos)  # src, dst ride the header
         else:
             raise ValueError(f"unknown control op {op}")
         if on_replay is not None:

@@ -53,6 +53,8 @@ class EngineStats:
     # emitted/lane_steps reads as acceptance in [1, K+1]:
     spec_emitted: int = 0  # tokens consumed from spec steps, drafted lanes
     spec_lane_steps: int = 0  # (drafted lane, spec-step) pairs
+    prefix_hits: int = 0  # admissions that reused another lane's KV prefix
+    prefix_tokens_saved: int = 0  # prompt tokens NOT re-prefilled
     # estimated per-step collective payload (bytes/chip), from the compiled
     # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
     sync_bytes_per_decode: int = 0
@@ -63,6 +65,7 @@ class EngineStats:
         self.prefill_s = self.decode_s = 0.0
         self.prefill_tokens = self.decode_steps = self.host_bytes_in = 0
         self.spec_steps = self.spec_emitted = self.spec_lane_steps = 0
+        self.prefix_hits = self.prefix_tokens_saved = 0
         # sync_* stay: they describe the compiled program, not a window
         return snap
 
@@ -288,6 +291,22 @@ class InferenceEngine:
                 KVCache(k=k, v=v),
             )
 
+        @partial(jax.jit, donate_argnums=(0,))
+        def _copy_lane(cache, src, dst):
+            # whole-lane KV copy (prefix caching): static shapes mean ONE
+            # compile for any prefix length; slots past the shared prefix
+            # are garbage for dst, but dst's prefill rewrites them before
+            # any query can read them (the chunked-prefill invariant). The
+            # copy is an HBM-to-HBM move (~cache-lane bytes), orders of
+            # magnitude cheaper than re-prefilling the prefix.
+            k_src = jax.lax.dynamic_index_in_dim(cache.k, src, axis=1, keepdims=False)
+            v_src = jax.lax.dynamic_index_in_dim(cache.v, src, axis=1, keepdims=False)
+            return KVCache(
+                k=cache.k.at[:, dst].set(k_src),
+                v=cache.v.at[:, dst].set(v_src),
+            )
+
+        self._copy_lane_fn = _copy_lane
         self._decode_fn = _decode
         self._prefill_fn = _prefill
         # AOT-compiled decode executable (set by collective_stats, which
@@ -548,6 +567,19 @@ class InferenceEngine:
         out = np.asarray(logits)
         self.stats.host_bytes_in += out.nbytes
         return out
+
+    def copy_lane(self, src: int, dst: int) -> None:
+        """Copy lane ``src``'s whole KV cache into lane ``dst`` (prefix
+        caching: a new request sharing a prompt prefix with tokens already
+        resident in ``src`` skips prefilling that prefix — the scheduler
+        tracks which tokens each lane's cache holds and calls this before
+        prefilling only the tail). No reference analogue: its lanes share
+        one cache (defect (c)), so prefix reuse is impossible there."""
+        if src == dst:
+            return
+        self.cache = self._copy_lane_fn(
+            self.cache, jnp.int32(src), jnp.int32(dst)
+        )
 
     def reset_lane(self, lane: int) -> None:
         """Nothing to clear on device: a fresh request's prefill rewrites the

@@ -100,6 +100,15 @@ class RequestQueue:
                 return out
 
 
+def _common_prefix_len(a, b) -> int:
+    """Length of the longest common leading run of two token lists."""
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
 @dataclass
 class _Lane:
     request: Request | None = None
@@ -135,6 +144,7 @@ class ContinuousBatchingScheduler:
         eos_padding: tuple[int, int] = (2, 2),
         host_sampling: bool = False,
         speculative: bool = True,
+        prefix_min_tokens: int = 16,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
@@ -143,14 +153,25 @@ class ContinuousBatchingScheduler:
 
         ``speculative=False`` disables prompt-lookup speculative decoding
         (greedy-lane draft verification); it is otherwise used automatically
-        whenever the engine supports it."""
+        whenever the engine supports it.
+
+        ``prefix_min_tokens`` gates prefix caching: a new request whose
+        prompt shares at least that many leading tokens with the tokens
+        already resident in some lane's KV cache (including finished
+        lanes — their KV stays until overwritten) skips prefilling the
+        shared prefix via ``engine.copy_lane``. 0 disables."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.queue = queue_ or RequestQueue()
         self.eos_padding = eos_padding
         self.host_sampling = host_sampling
         self.speculative = speculative
+        self.prefix_min_tokens = prefix_min_tokens
         self._lanes = [_Lane() for _ in range(engine.n_lanes)]
+        # tokens whose KV each lane's cache currently holds at slots
+        # [0, len): survives request finish (the KV physically remains),
+        # reset when a new request claims the lane
+        self._lane_kv: list[list[int]] = [[] for _ in range(engine.n_lanes)]
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._chat_stops = TokenizerChatStops(tokenizer)
@@ -219,10 +240,34 @@ class ContinuousBatchingScheduler:
             tokens = tokens[-(max_ctx - req.max_tokens - 1) :] if max_ctx > req.max_tokens + 1 else tokens[-max_ctx + 1 :]
         req.n_prompt_tokens = len(tokens)
 
+        # prefix caching: if some lane's resident KV (finished lanes
+        # included — their cache persists until overwritten) shares a long
+        # enough prompt prefix, copy that lane's KV (an HBM move, orders of
+        # magnitude cheaper than prefill) and prefill only the tail. A chat
+        # follow-up landing on its own previous lane hits with src == dst,
+        # which copy_lane no-ops.
+        start = 0
+        if (
+            self.prefix_min_tokens > 0
+            and getattr(self.engine, "copy_lane", None) is not None
+        ):
+            best_lane, best_lcp = -1, 0
+            for j, kv in enumerate(self._lane_kv):
+                lcp = _common_prefix_len(tokens, kv)
+                if lcp > best_lcp:
+                    best_lane, best_lcp = j, lcp
+            best_lcp = min(best_lcp, len(tokens) - 1)  # >= 1 token to prefill
+            if best_lcp >= self.prefix_min_tokens:
+                self.engine.copy_lane(best_lane, lane_idx)
+                start = best_lcp
+                self.engine.stats.prefix_hits += 1
+                self.engine.stats.prefix_tokens_saved += best_lcp
+        self._lane_kv[lane_idx] = list(tokens[:start])
+
         lane = self._lanes[lane_idx]
         lane.request = req
-        lane.pos = 0
-        lane.pending = list(tokens)
+        lane.pos = start
+        lane.pending = list(tokens[start:])
         lane.drafter = NgramDraftIndex(tokens)  # seed with the prompt
         lane.seed = (
             req.seed if req.seed is not None else int(time.time() * 1e6)
@@ -275,6 +320,7 @@ class ContinuousBatchingScheduler:
             return True
         lane.pos += len(chunk)
         lane.pending = lane.pending[len(chunk):]
+        self._lane_kv[lane_idx].extend(chunk)  # committed: prefix-cacheable
         if lane.pending:
             return True
         # prompt complete: pick the first generated token
@@ -294,6 +340,7 @@ class ContinuousBatchingScheduler:
         False when the lane finished (EOS or length)."""
         req = lane.request
         req.generated_tokens.append(tok)
+        self._lane_kv[lane_idx].append(tok)  # its KV write is committed
         lane.drafter.append(tok)
         piece = lane.decoder.decode(tok)
         result = lane.eos.append(tok, piece)
@@ -361,16 +408,18 @@ class ContinuousBatchingScheduler:
                 continue
 
             tokens = np.zeros(n_lanes, np.int32)
-            positions = np.zeros(n_lanes, np.int32)
+            # EVERY lane gets a KV write from this decode step (one compiled
+            # program, all lanes scatter). Idle/finished lanes point at
+            # seq_len so the mode="drop" scatter discards the junk write
+            # outright — position 0 would clobber slot 0 of a finished
+            # lane's cache, which prefix caching may still reuse
+            # (round-5 code-review finding). Lanes mid-prefill point at
+            # their next unwritten slot, which the next prefill chunk
+            # rewrites before any query can read it.
+            positions = np.full(n_lanes, cfg.seq_len, np.int32)
             temps = np.zeros(n_lanes, np.float32)
             topps = np.full(n_lanes, 0.9, np.float32)
             seeds = np.zeros(n_lanes, np.uint32)
-            # lanes mid-prefill still get a KV write from this decode step
-            # (one compiled program, all lanes scatter); point it at the
-            # lane's next unwritten slot, which the next prefill chunk
-            # rewrites before any query can read it. Position 0 would
-            # corrupt already-prefilled state (empty lanes are safe at 0:
-            # admission rewrites from 0).
             for i, lane in enumerate(self._lanes):
                 if lane.request is not None and lane.pending:
                     positions[i] = lane.pos

@@ -114,6 +114,8 @@ class ApiServer:
                 if stats.spec_lane_steps else None
             ),
             "sync_bytes_per_decode": stats.sync_bytes_per_decode,
+            "prefix_hits": stats.prefix_hits,
+            "prefix_tokens_saved": stats.prefix_tokens_saved,
             "lanes_total": total,
             "lanes_busy": busy,
         }
