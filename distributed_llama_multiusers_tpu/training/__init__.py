@@ -1,0 +1,3 @@
+from .trainer import Trainer, make_train_step, next_token_loss
+
+__all__ = ["Trainer", "make_train_step", "next_token_loss"]

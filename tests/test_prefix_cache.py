@@ -110,7 +110,7 @@ def test_scheduler_prefix_hit_skips_prefill_and_keeps_stream(loaded):
     assert engine.stats.prefix_hits == 1
     # the second request's prompt processing started past the shared
     # prefix: no prefill chunk after the first request re-ran position 0
-    first_prompt_chunks = len(tok.encode(prompts[0])) // 8 + 1
+    first_prompt_chunks = -(-len(tok.encode(prompts[0])) // 8)  # ceil div
     assert all(c[2] > 0 for c in chunks[first_prompt_chunks:]), chunks
     n_shared = len(tok.encode(prompts[0][:-2]))
     assert engine.stats.prefix_tokens_saved >= n_shared - 8  # >= prefix - bucket
