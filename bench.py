@@ -400,6 +400,10 @@ def _phase_serving(config, small):
             round(stats.spec_emitted / stats.spec_lane_steps, 2)
             if stats.spec_lane_steps else None
         ),
+        # the 8 requests share a prompt, so admissions 2..8 reuse lane KV
+        # via prefix caching — the measured serving number includes it
+        "prefix_hits": stats.prefix_hits,
+        "prefix_tokens_saved": stats.prefix_tokens_saved,
     }
 
 

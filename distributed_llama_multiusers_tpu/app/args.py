@@ -28,7 +28,10 @@ def _float_type(s: str) -> int:
 def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog=prog)
     if not api:
-        p.add_argument("mode", choices=["inference", "chat", "worker"], help="run mode (src/dllama.cpp:216-239)")
+        p.add_argument("mode", choices=["inference", "chat", "worker", "train"],
+                       help="run mode (src/dllama.cpp:216-239; train is a "
+                            "beyond-parity extension — the reference is "
+                            "inference-only)")
     p.add_argument("--model", help="path to .m model file")
     p.add_argument("--tokenizer", help="path to .t tokenizer file")
     p.add_argument("--prompt", default=None)
@@ -75,6 +78,19 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--no-spec", action="store_true",
                    help="disable prompt-lookup speculative decoding "
                         "(serving and greedy CLI inference)")
+    # train mode (beyond parity — no reference analogue)
+    p.add_argument("--data", default=None,
+                   help="train: UTF-8 text file tokenized into training batches")
+    p.add_argument("--train-steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--train-seq-len", type=int, default=0,
+                   help="tokens per training sequence (0 = model seq_len)")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="train: save/resume orbax checkpoints here "
+                        "(resumes from the latest step_<N> if present)")
+    p.add_argument("--save-every", type=int, default=50,
+                   help="train: checkpoint every N steps (and at the end)")
     return p
 
 

@@ -212,6 +212,88 @@ def run_worker(args) -> None:
     log("⭕", "Root sent stop; worker exiting")
 
 
+def run_train(args) -> None:
+    """Next-token LM training on a text file — beyond parity (the
+    reference is inference-only, src/app.cpp has no training path).
+    Dense weights (training needs differentiable parameters, so Q40
+    models load dequantized), optax AdamW, orbax checkpoints in
+    --ckpt-dir with automatic resume from the latest step_<N>."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..formats.model_file import load_model_header
+    from ..models.loader import load_params_from_m
+    from ..tokenizer import Tokenizer
+    from ..training import Trainer
+    from .args import parse_mesh_spec
+
+    if not (args.model and args.tokenizer):
+        print("error: --model and --tokenizer are required", file=sys.stderr)
+        raise SystemExit(2)
+    if not args.data:
+        print("error: train mode needs --data <utf-8 text file>", file=sys.stderr)
+        raise SystemExit(2)
+    h = load_model_header(args.model, max_seq_len=args.max_seq_len)
+    config, params = load_params_from_m(args.model, h, dtype=jnp.float32)
+    tokenizer = Tokenizer(args.tokenizer)
+
+    with open(args.data, encoding="utf-8") as f:
+        ids = tokenizer.encode(f.read())
+    t_len = args.train_seq_len or config.seq_len
+    n_win = len(ids) // t_len
+    if n_win == 0:
+        raise SystemExit(
+            f"--data has {len(ids)} tokens; need at least one {t_len}-token window"
+        )
+    windows = np.asarray(ids[: n_win * t_len], np.int32).reshape(n_win, t_len)
+    log("📄", f"Data: {len(ids)} tokens -> {n_win} windows of {t_len}")
+
+    # same mesh-setup sequence as load_stack: validate the plan against the
+    # model BEFORE sharding so bad --workers specs fail with a clear error,
+    # and skip mesh setup entirely for a single device
+    mesh = None
+    plan = parse_mesh_spec(args.workers)
+    if plan is not None and plan.n_devices > 1:
+        from ..parallel import make_mesh, validate_mesh_for_config
+        from ..parallel.sharding import shard_params
+
+        validate_mesh_for_config(config, plan)
+        mesh = make_mesh(plan)
+        params = shard_params(params, mesh)
+        log("🕸", f"Training over mesh {dict(mesh.shape)}")
+
+    trainer = Trainer(config, params, optax.adamw(args.lr), mesh=mesh)
+    if args.ckpt_dir and Trainer.latest_step(args.ckpt_dir) is not None:
+        trainer.restore(args.ckpt_dir)
+        log("💾", f"Resumed from step {trainer.step_count} in {args.ckpt_dir}")
+
+    # deterministic batch order: replay the skipped draws on resume so a
+    # resumed run consumes the same batches a straight run would
+    rng = np.random.default_rng(args.seed or 0)
+    for _ in range(trainer.step_count):
+        rng.integers(0, n_win, size=args.batch_size)
+
+    tokens_per_step = args.batch_size * t_len
+    last_saved = None
+    while trainer.step_count < args.train_steps:
+        idx = rng.integers(0, n_win, size=args.batch_size)
+        t0 = time.perf_counter()
+        loss = trainer.step(windows[idx])
+        dt = time.perf_counter() - t0
+        log("📉", f"step {trainer.step_count:5d}  loss {loss:8.4f}  "
+            f"{tokens_per_step / dt:8.1f} tok/s")
+        if (
+            args.ckpt_dir
+            and args.save_every > 0
+            and trainer.step_count % args.save_every == 0
+        ):
+            log("💾", f"Checkpoint: {trainer.save(args.ckpt_dir)}")
+            last_saved = trainer.step_count
+    if args.ckpt_dir and last_saved != trainer.step_count:
+        log("💾", f"Final checkpoint: {trainer.save(args.ckpt_dir)}")
+
+
 def main(argv=None) -> None:
     honor_cpu_platform_env()
     args = build_parser("dllama").parse_args(argv)
@@ -221,6 +303,8 @@ def main(argv=None) -> None:
         run_chat(args)
     elif args.mode == "worker":
         run_worker(args)
+    elif args.mode == "train":
+        run_train(args)
 
 
 if __name__ == "__main__":

@@ -11,8 +11,8 @@ forward is ``llama_forward_train`` — bit-identical layer math to the
 serving path, sharded over the same GSPMD mesh axes (dp/tp/sp/ep).
 
 Checkpoints are orbax PyTree checkpoints (the TPU-native format: async-
-capable, sharding-aware, multi-host-safe), laid out as
-``<dir>/step_<N>/{params,opt_state,meta}``.
+capable, sharding-aware, multi-host-safe): one atomic checkpoint
+``<dir>/step_<N>`` holding ``{params, opt_state}``.
 """
 
 from __future__ import annotations
@@ -90,14 +90,22 @@ class Trainer:
     # -- checkpoint/resume --------------------------------------------------
 
     def save(self, ckpt_dir: str) -> str:
-        """Write ``<ckpt_dir>/step_<N>`` (orbax PyTree checkpoints for
-        params and opt_state); returns the step directory."""
+        """Write ``<ckpt_dir>/step_<N>`` as ONE orbax PyTree checkpoint
+        holding {params, opt_state}; returns the step directory. A single
+        checkpoint is atomic (orbax stages to a tmp dir and renames), so a
+        kill mid-save can never leave a half-written step_<N> that
+        ``latest_step`` would pick and brick resume on."""
         import orbax.checkpoint as ocp
 
         step_dir = os.path.join(os.path.abspath(ckpt_dir), f"step_{self.step_count}")
         ckpt = ocp.PyTreeCheckpointer()
-        ckpt.save(os.path.join(step_dir, "params"), self.params)
-        ckpt.save(os.path.join(step_dir, "opt_state"), self.opt_state)
+        # force: re-saving the same step (a rerun over an old directory)
+        # replaces instead of raising
+        ckpt.save(
+            step_dir,
+            {"params": self.params, "opt_state": self.opt_state},
+            force=True,
+        )
         return step_dir
 
     @staticmethod
@@ -122,18 +130,16 @@ class Trainer:
                 raise FileNotFoundError(f"no step_<N> checkpoints in {ckpt_dir}")
         step_dir = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
         ckpt = ocp.PyTreeCheckpointer()
-
-        def load(name, template):
-            # restore_args carry the template's shardings, so a mesh-sharded
-            # trainer resumes straight into its GSPMD layout (and the
-            # "populating sharding from file" warning never applies)
-            return ckpt.restore(
-                os.path.join(step_dir, name),
-                item=template,
-                restore_args=ocp.checkpoint_utils.construct_restore_args(template),
-            )
-
-        self.params = load("params", self.params)
-        self.opt_state = load("opt_state", self.opt_state)
+        # restore_args carry the templates' shardings, so a mesh-sharded
+        # trainer resumes straight into its GSPMD layout (and the
+        # "populating sharding from file" warning never applies)
+        template = {"params": self.params, "opt_state": self.opt_state}
+        restored = ckpt.restore(
+            step_dir,
+            item=template,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(template),
+        )
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
         self.step_count = step
         return self
