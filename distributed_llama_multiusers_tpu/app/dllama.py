@@ -241,6 +241,15 @@ def run_train(args) -> None:
     with open(args.data, encoding="utf-8") as f:
         ids = tokenizer.encode(f.read())
     t_len = args.train_seq_len or config.seq_len
+    if t_len > config.seq_len:
+        # RoPE tables are seq_len rows; longer windows would silently
+        # clamp-gather the last rotation for every position past seq_len
+        print(
+            f"error: --train-seq-len {t_len} exceeds the model's seq_len "
+            f"{config.seq_len} (RoPE table size)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     n_win = len(ids) // t_len
     if n_win == 0:
         raise SystemExit(

@@ -96,9 +96,28 @@ def load() -> ctypes.CDLL | None:
         lib.dlq_f16_to_f32.argtypes = [c_u16p, c_f32p, ctypes.c_int64, ctypes.c_int]
         lib.dlq_f32_to_f16.argtypes = [c_f32p, c_u16p, ctypes.c_int64, ctypes.c_int]
         lib.dlq_abi_version.restype = ctypes.c_int
-        if lib.dlq_abi_version() != 1:
+        # version gate FIRST: a stale v1 build (or a DLLAMA_NATIVE_SO
+        # override) must fall back cleanly, not AttributeError on symbols
+        # that predate it
+        if lib.dlq_abi_version() != 2:
             _load_failed = True
             return None
+        c_i32p = ctypes.POINTER(ctypes.c_int32)
+        c_i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.dllama_bpe_create.argtypes = [
+            c_u8p, c_i64p, ctypes.c_int32, ctypes.c_int32, c_f32p,
+        ]
+        lib.dllama_bpe_create.restype = ctypes.c_void_p
+        lib.dllama_bpe_destroy.argtypes = [ctypes.c_void_p]
+        lib.dllama_bpe_merge.argtypes = [
+            ctypes.c_void_p, c_i32p, ctypes.c_int32, c_i32p,
+        ]
+        lib.dllama_bpe_merge.restype = ctypes.c_int32
+        lib.dllama_bpe_encode.argtypes = [
+            ctypes.c_void_p, c_u8p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int, c_i32p,
+        ]
+        lib.dllama_bpe_encode.restype = ctypes.c_int32
         _lib = lib
         return _lib
 
@@ -174,3 +193,61 @@ def dequantize_q80(blocks: np.ndarray) -> np.ndarray | None:
     out = np.empty(blocks.shape[0] * 32, np.float32)
     lib.dlq_q80_dequantize(_ptr(blocks, ctypes.c_uint8), _ptr(out, ctypes.c_float), blocks.shape[0], _threads())
     return out
+
+
+class NativeBpe:
+    """C++ BPE pair-merge context (tokenizer encode hot path). Holds the
+    vocab/score tables native-side; ``merge`` is a single ctypes call per
+    prompt. Token-identical to Tokenizer._merge (tests/test_native.py
+    A/Bs them); falls back to None when the library is unavailable."""
+
+    def __init__(self, vocab: list, regular_size: int, scores: list):
+        lib = load()
+        if lib is None:
+            raise OSError("native library unavailable")
+        concat = b"".join(vocab)
+        buf = np.frombuffer(concat, np.uint8) if concat else np.zeros(1, np.uint8)
+        offsets = np.zeros(len(vocab) + 1, np.int64)
+        np.cumsum([len(v) for v in vocab], out=offsets[1:])
+        sc = np.ascontiguousarray(scores, np.float32)
+        self._lib = lib
+        self._handle = lib.dllama_bpe_create(
+            _ptr(np.ascontiguousarray(buf), ctypes.c_uint8),
+            _ptr(offsets, ctypes.c_int64),
+            len(vocab), regular_size,
+            _ptr(sc, ctypes.c_float),
+        )
+        if not self._handle:
+            raise OSError("dllama_bpe_create failed")
+
+    def merge(self, ids: list) -> list:
+        arr = np.ascontiguousarray(ids, np.int32)
+        out = np.empty(max(len(arr), 1), np.int32)
+        m = self._lib.dllama_bpe_merge(
+            self._handle,
+            _ptr(arr, ctypes.c_int32), len(arr),
+            _ptr(out, ctypes.c_int32),
+        )
+        return out[:m].tolist()
+
+    def encode(self, text: bytes, bos: int, add_special: bool):
+        """Full scan+merge in one native call; None when the text has an
+        untokenizable buffer (caller falls back to the Python encoder for
+        the exact exception)."""
+        data = np.frombuffer(text, np.uint8) if text else np.zeros(1, np.uint8)
+        out = np.empty(len(text) + 1, np.int32)
+        m = self._lib.dllama_bpe_encode(
+            self._handle,
+            _ptr(np.ascontiguousarray(data), ctypes.c_uint8), len(text),
+            bos, int(add_special),
+            _ptr(out, ctypes.c_int32),
+        )
+        if m < 0:
+            return None
+        return out[:m].tolist()
+
+    def __del__(self):
+        h = getattr(self, "_handle", None)
+        if h:
+            self._lib.dllama_bpe_destroy(h)
+            self._handle = None

@@ -18,6 +18,9 @@
 #include <algorithm>
 #include <thread>
 #include <vector>
+#include <string>
+#include <unordered_map>
+#include <queue>
 
 namespace {
 
@@ -225,6 +228,163 @@ void dlq_f32_to_f16(const float *in, uint16_t *out, int64_t n, int n_threads) {
     });
 }
 
-int dlq_abi_version(void) { return 1; }
+int dlq_abi_version(void) { return 2; }
+
+} // extern "C"
+
+// ---------------------------------------------------------------------------
+// BPE pair-merge — the tokenizer's encode hot path (counterpart of the
+// reference's iterative best-score merge, src/tokenizer.cpp:340-368). Same
+// heap-over-candidate-pairs algorithm as tokenizer.Tokenizer._merge, with
+// the identical order contract (strictly-best score, EARLIEST pair on
+// ties), so native and Python merges are token-identical; the Python side
+// A/B-checks this in tests/test_native.py. Long prompts (the long-context
+// serving workload) spend their admission time here.
+
+namespace {
+
+struct BpeCtx {
+    std::vector<std::string> vocab;   // id -> bytes, FULL vocab (specials too)
+    std::vector<float> scores;        // full vocab
+    // regular-vocab bytes -> id; built with emplace over ascending ids so
+    // duplicates keep the FIRST id, matching dict.setdefault in Python
+    std::unordered_map<std::string, int32_t> regular;
+    // specials grouped by first byte, id order within a group — the scan
+    // takes the first prefix match, like Tokenizer._find_special_at
+    std::vector<std::vector<std::pair<int32_t, const std::string *>>> specials_by_first;
+};
+
+// the iterative best-score pair merge over a linked list + candidate heap;
+// mutates ids in place and returns the merged length (algorithm contract
+// documented at dllama_bpe_merge below)
+int32_t bpe_merge_core(BpeCtx *ctx, std::vector<int32_t> &ids) {
+    const int32_t V = (int32_t)ctx->vocab.size();
+    const int32_t n = (int32_t)ids.size();
+    if (n < 2) return n;
+    std::vector<int32_t> nxt(n), prv(n);
+    std::vector<char> alive(n, 1);
+    for (int32_t j = 0; j < n; j++) { nxt[j] = j + 1; prv[j] = j - 1; }
+
+    struct Cand { float neg_score; int32_t j, merged, a, b; };
+    auto cmp = [](const Cand &x, const Cand &y) {
+        if (x.neg_score != y.neg_score) return x.neg_score > y.neg_score;
+        return x.j > y.j;
+    };
+    std::priority_queue<Cand, std::vector<Cand>, decltype(cmp)> heap(cmp);
+    std::string key;
+    auto push = [&](int32_t j) {
+        const int32_t k = nxt[j];
+        if (k >= n) return;
+        const int32_t a = ids[j], b = ids[k];
+        if (a < 0 || b < 0 || a >= V || b >= V) return;
+        key.assign(ctx->vocab[a]);
+        key.append(ctx->vocab[b]);
+        auto it = ctx->regular.find(key);
+        if (it == ctx->regular.end()) return;
+        const int32_t m = it->second;
+        if ((double)ctx->scores[m] > -1e10)  // double, like Python
+            heap.push({-ctx->scores[m], j, m, a, b});
+    };
+    for (int32_t j = 0; j + 1 < n; j++) push(j);
+    while (!heap.empty()) {
+        const Cand c = heap.top();
+        heap.pop();
+        const int32_t j = c.j, k = nxt[j];
+        // stale entry: one side merged away or re-merged since the push
+        if (!alive[j] || k >= n || ids[j] != c.a || ids[k] != c.b) continue;
+        ids[j] = c.merged;
+        alive[k] = 0;
+        nxt[j] = nxt[k];
+        if (nxt[k] < n) prv[nxt[k]] = j;
+        if (prv[j] >= 0) push(prv[j]);
+        push(j);
+    }
+    int32_t m = 0;
+    for (int32_t j = 0; j < n; j++)
+        if (alive[j]) ids[m++] = ids[j];
+    ids.resize(m);
+    return m;
+}
+
+} // namespace
+
+extern "C" {
+
+void *dllama_bpe_create(const uint8_t *vocab_bytes, const int64_t *offsets,
+                        int32_t n_vocab, int32_t n_regular,
+                        const float *scores) {
+    auto *ctx = new BpeCtx();
+    ctx->vocab.reserve(n_vocab);
+    ctx->scores.assign(scores, scores + n_vocab);
+    for (int32_t i = 0; i < n_vocab; i++)
+        ctx->vocab.emplace_back((const char *)vocab_bytes + offsets[i],
+                                (size_t)(offsets[i + 1] - offsets[i]));
+    ctx->regular.reserve((size_t)n_regular * 2);
+    for (int32_t i = 0; i < n_regular; i++)
+        ctx->regular.emplace(ctx->vocab[i], i);
+    ctx->specials_by_first.resize(256);
+    for (int32_t i = n_regular; i < n_vocab; i++)
+        if (!ctx->vocab[i].empty())
+            ctx->specials_by_first[(uint8_t)ctx->vocab[i][0]].emplace_back(
+                i, &ctx->vocab[i]);
+    return ctx;
+}
+
+void dllama_bpe_destroy(void *ctx) { delete (BpeCtx *)ctx; }
+
+int32_t dllama_bpe_merge(void *vctx, const int32_t *ids_in, int32_t n,
+                         int32_t *out) {
+    auto *ctx = (BpeCtx *)vctx;
+    std::vector<int32_t> ids(ids_in, ids_in + n);
+    const int32_t m = bpe_merge_core(ctx, ids);
+    std::copy(ids.begin(), ids.end(), out);
+    return m;
+}
+
+// Full encode: greedy special-token scan + byte-buffer seed + merge, one
+// call per prompt (counterpart of Tokenizer.encode's scan loop +
+// src/tokenizer.cpp:301-380). bos >= 0 is prepended BEFORE the merge, as
+// in Python where the BOS participates in pair merging. Returns the token
+// count, or -(byte_pos+1) when a buffer is untokenizable — the caller
+// falls back to the Python encoder, which raises the exact error.
+int32_t dllama_bpe_encode(void *vctx, const uint8_t *text, int64_t n,
+                          int32_t bos, int add_special, int32_t *out) {
+    auto *ctx = (BpeCtx *)vctx;
+    std::vector<int32_t> toks;
+    toks.reserve((size_t)n + 1);
+    if (bos >= 0) toks.push_back(bos);
+    std::string buf;
+    int64_t i = 0;
+    while (i < n) {
+        if (add_special) {
+            int32_t special = -1;
+            for (const auto &cand : ctx->specials_by_first[text[i]]) {
+                const std::string &piece = *cand.second;
+                if ((int64_t)piece.size() <= n - i &&
+                    std::memcmp(piece.data(), text + i, piece.size()) == 0) {
+                    special = cand.first;
+                    break;
+                }
+            }
+            if (special >= 0) {
+                if (!buf.empty()) return (int32_t)(-(i + 1));
+                toks.push_back(special);
+                i += (int64_t)ctx->vocab[special].size();
+                continue;
+            }
+        }
+        buf.push_back((char)text[i]);
+        i++;
+        auto it = ctx->regular.find(buf);
+        if (it != ctx->regular.end()) {
+            toks.push_back(it->second);
+            buf.clear();
+        }
+    }
+    if (!buf.empty()) return (int32_t)(-(n + 1));
+    const int32_t m = bpe_merge_core(ctx, toks);
+    std::copy(toks.begin(), toks.end(), out);
+    return m;
+}
 
 } // extern "C"

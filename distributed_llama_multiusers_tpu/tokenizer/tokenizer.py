@@ -16,6 +16,11 @@ import heapq
 
 from ..formats.tokenizer_file import TokenizerData, load_tokenizer_file
 
+# prompts at least this long merge in C++ (native.NativeBpe) when the
+# library is available; below it the ctypes boundary costs more than the
+# Python heap saves
+NATIVE_MERGE_MIN_TOKENS = 256
+
 _FFFD = b"\xef\xbf\xbd"
 
 
@@ -51,6 +56,7 @@ class Tokenizer:
             if piece:
                 self._specials_by_first.setdefault(piece[0], []).append((tid, piece))
         self._decode_pending = b""  # held-back bytes of an incomplete UTF-8 seq
+        self._native_bpe = None  # lazy C++ merge context (False = unavailable)
 
     # ---- encode -----------------------------------------------------------
 
@@ -62,6 +68,18 @@ class Tokenizer:
     ) -> list[int]:
         if isinstance(text, str):
             text = text.encode("utf-8")
+        if len(text) >= NATIVE_MERGE_MIN_TOKENS:
+            # long prompts (long-context admission) run the whole
+            # scan+merge in C++ — one ctypes call, token-identical
+            # (A/B'd in test_native.py). None = untokenizable somewhere:
+            # fall through so the Python path raises the exact error.
+            native = self._get_native_bpe()
+            if native is not None:
+                out = native.encode(
+                    text, self.bos_id if add_bos else -1, add_special_tokens
+                )
+                if out is not None:
+                    return out
         tokens: list[int] = []
         if add_bos:
             tokens.append(self.bos_id)
@@ -102,6 +120,12 @@ class Tokenizer:
         n = len(tokens)
         if n < 2:
             return tokens
+        if n >= NATIVE_MERGE_MIN_TOKENS:
+            # long prompts (the long-context admission path) take the C++
+            # merge — token-identical by contract, A/B'd in test_native.py
+            native = self._get_native_bpe()
+            if native is not None:
+                return native.merge(tokens)
         ids = list(tokens)
         nxt = list(range(1, n + 1))  # n = end sentinel
         prv = list(range(-1, n - 1))
@@ -138,6 +162,20 @@ class Tokenizer:
                 push(prv[j])
             push(j)
         return [ids[j] for j in range(n) if alive[j]]
+
+    def _get_native_bpe(self):
+        """Lazy C++ merge context; False caches unavailability so the
+        fallback costs one attribute check per encode."""
+        if self._native_bpe is None:
+            try:
+                from ..native import NativeBpe
+
+                self._native_bpe = NativeBpe(
+                    self.vocab, self.regular_vocab_size, self.scores
+                )
+            except OSError:
+                self._native_bpe = False
+        return self._native_bpe or None
 
     def _find_special_at(self, text: bytes, pos: int) -> int | None:
         # candidates share the first byte; kept in id order so the first

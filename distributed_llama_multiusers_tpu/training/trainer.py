@@ -133,13 +133,28 @@ class Trainer:
         # restore_args carry the templates' shardings, so a mesh-sharded
         # trainer resumes straight into its GSPMD layout (and the
         # "populating sharding from file" warning never applies)
-        template = {"params": self.params, "opt_state": self.opt_state}
-        restored = ckpt.restore(
-            step_dir,
-            item=template,
-            restore_args=ocp.checkpoint_utils.construct_restore_args(template),
-        )
-        self.params = restored["params"]
-        self.opt_state = restored["opt_state"]
+        if os.path.isdir(os.path.join(step_dir, "params")):
+            # legacy two-checkpoint layout (step_<N>/{params,opt_state}):
+            # readable forever; new saves always write the atomic layout
+            def load(name, template):
+                return ckpt.restore(
+                    os.path.join(step_dir, name),
+                    item=template,
+                    restore_args=ocp.checkpoint_utils.construct_restore_args(
+                        template
+                    ),
+                )
+
+            self.params = load("params", self.params)
+            self.opt_state = load("opt_state", self.opt_state)
+        else:
+            template = {"params": self.params, "opt_state": self.opt_state}
+            restored = ckpt.restore(
+                step_dir,
+                item=template,
+                restore_args=ocp.checkpoint_utils.construct_restore_args(template),
+            )
+            self.params = restored["params"]
+            self.opt_state = restored["opt_state"]
         self.step_count = step
         return self

@@ -126,3 +126,72 @@ def test_q40_tie_break_matches_numpy():
     a = native.quantize_q40(x)
     b = codec.quantize_q40(x)
     assert a.tobytes() == b.tobytes()
+
+
+class TestNativeBpe:
+    """The C++ scan+merge encoder must be TOKEN-IDENTICAL to the Python
+    tokenizer on every path: plain text, specials, bos on/off, specials
+    on/off, untokenizable fallback. The contract is exactness, not
+    closeness — prompts admit through whichever side the length threshold
+    picks, and streams must not depend on it."""
+
+    @pytest.fixture(scope="class")
+    def tok(self, tiny_model):
+        from distributed_llama_multiusers_tpu.tokenizer import Tokenizer
+
+        return Tokenizer(tiny_model["tokenizer"])
+
+    def _ab(self, tok, text, **kw):
+        import distributed_llama_multiusers_tpu.tokenizer.tokenizer as tm
+
+        old = tm.NATIVE_MERGE_MIN_TOKENS
+        try:
+            tm.NATIVE_MERGE_MIN_TOKENS = 10**9  # force Python
+            py = tok.encode(text, **kw)
+            tm.NATIVE_MERGE_MIN_TOKENS = 1  # force native
+            nat = tok.encode(text, **kw)
+        finally:
+            tm.NATIVE_MERGE_MIN_TOKENS = old
+        assert nat == py, (nat[:20], py[:20])
+        return py
+
+    def test_long_random_text_identical(self, tok):
+        import random
+
+        random.seed(3)
+        text = "".join(random.choice("abcdefgh .,") for _ in range(50_000))
+        out = self._ab(tok, text)
+        assert len(out) > 1000
+
+    def test_specials_and_flags_identical(self, tok):
+        sp = tok.vocab[tok.vocab_size - 1].decode()
+        text = ("hello world " + sp) * 500
+        self._ab(tok, text)
+        self._ab(tok, text, add_bos=False)
+        self._ab(tok, "abc " * 2000, add_special_tokens=False)
+
+    def test_untokenizable_falls_back_to_python_error(self, tok):
+        import distributed_llama_multiusers_tpu.tokenizer.tokenizer as tm
+
+        # a byte outside the tiny vocab: native returns None, the Python
+        # path raises the exact error either way
+        bad = ("abc " * 200) + "\xff\xff"
+        old = tm.NATIVE_MERGE_MIN_TOKENS
+        try:
+            tm.NATIVE_MERGE_MIN_TOKENS = 1
+            with pytest.raises(ValueError, match="untokenizable"):
+                tok.encode(bad)
+        finally:
+            tm.NATIVE_MERGE_MIN_TOKENS = old
+
+    def test_merge_entry_point_identical(self, tok):
+        """The standalone merge ABI (used when the seed tokens are already
+        known) matches Tokenizer._merge."""
+        from distributed_llama_multiusers_tpu.native import NativeBpe
+
+        nb = NativeBpe(tok.vocab, tok.regular_vocab_size, tok.scores)
+        import random
+
+        random.seed(5)
+        ids = [random.randrange(tok.regular_vocab_size) for _ in range(5000)]
+        assert nb.merge(ids) == tok._merge(ids)
