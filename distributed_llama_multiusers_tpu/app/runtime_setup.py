@@ -166,7 +166,8 @@ def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
     warmup_engine(engine, spec=speculative)
     log("⏳", f"Warmup done in {time.perf_counter() - t0:.1f}s")
     sched = ContinuousBatchingScheduler(
-        engine, tokenizer, speculative=speculative
+        engine, tokenizer, speculative=speculative,
+        prefix_min_tokens=getattr(args, "prefix_min_tokens", 16),
     )
     sched.start()
     return sched

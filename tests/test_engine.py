@@ -301,3 +301,30 @@ def test_f8_kv_cache_quarter_footprint(tiny_model):
     # f8 KV noise perturbs attention, not the weights: logits stay close
     np.testing.assert_allclose(logits8, logits32, atol=0.5, rtol=0.1)
     assert len(toks8) == len(toks32) == 16
+
+
+def test_f8_kv_cache_on_mesh_compiles_and_decodes(tiny_model):
+    """f8 KV + GSPMD mesh: the cache dtype change must compose with the
+    sharded serving programs (tp-sharded KV heads, replicated outputs)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.models.loader import load_params_from_m
+    from distributed_llama_multiusers_tpu.parallel import MeshPlan, make_mesh
+    from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.utils.testing import greedy_rollout
+
+    h = load_model_header(tiny_model["model"])
+    config, params = load_params_from_m(tiny_model["model"], h, dtype=jnp.float32)
+    mesh = make_mesh(MeshPlan(tp=2))
+    engine = InferenceEngine(
+        config, shard_params(params, mesh), n_lanes=2, prefill_buckets=(4,),
+        mesh=mesh, replicate_outputs=True, cache_dtype=jnp.float8_e4m3fn,
+    )
+    toks, _ = greedy_rollout(engine, [5, 9, 3], 8)
+    assert len(toks) == 8 and all(0 <= t < config.vocab_size for t in toks)
+    engine.copy_lane(0, 1)  # prefix-cache copy composes with f8 + mesh
+    logits, greedy, _ = engine.prefill(1, [7], start_pos=3)
+    assert np.all(np.isfinite(np.asarray(logits)))
