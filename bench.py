@@ -21,6 +21,8 @@ single phase can eat the budget:
                200 tok/s/chip (BASELINE.md), now on by default
   parity     — greedy token-identity of the shipping bf16-dot kernel vs
                exact f32 over 256 tokens (BASELINE.md gate-dtype clause)
+  longctx    — decode tok/s at FULL context (whole-KV attention reads),
+               bf16 KV vs --kv-dtype f8 (macbeth.sh's regime, measured)
 
 Perf-path hygiene: weights are generated DIRECTLY as random packed planes
 (no 2.5-16 GB dense intermediate on the host), so the first measurement
@@ -145,13 +147,18 @@ def _param_matmul_flops_per_token(config) -> int:
     return 2 * (config.n_layers * per_layer + d * config.vocab_size)
 
 
-def _bench_decode(config, params, n_short, n_long, reps=3, tag=""):
-    """Marginal decode tok/s for one param set."""
+def _bench_decode(config, params, n_short, n_long, reps=3, tag="",
+                  start_pos=0, cache_dtype=None):
+    """Marginal decode tok/s for one param set. ``start_pos``/``cache_dtype``
+    parameterize the long-context phase (full-KV attention reads, f8 KV)
+    without a second copy of the timing protocol."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from distributed_llama_multiusers_tpu.models import init_kv_cache, llama_forward
+
+    kv_dtype = cache_dtype or jnp.bfloat16
 
     def make_generate(n_steps):
         @partial(jax.jit, donate_argnums=(1,))
@@ -172,13 +179,13 @@ def _bench_decode(config, params, n_short, n_long, reps=3, tag=""):
         return generate
 
     first = jnp.zeros((1,), jnp.int32)
-    pos0 = jnp.zeros((1,), jnp.int32)
+    pos0 = jnp.full((1,), start_pos, jnp.int32)
 
     def timed(n_steps):
         gen = make_generate(n_steps)
 
         def run():
-            cache = init_kv_cache(config, n_lanes=1, dtype=jnp.bfloat16)
+            cache = init_kv_cache(config, n_lanes=1, dtype=kv_dtype)
             t0 = time.perf_counter()
             toks, _ = gen(params, cache, first, pos0)
             np.asarray(toks)  # forces completion (block_until_ready may not)
@@ -213,7 +220,10 @@ class _BenchTokenizer:
         self.vocab = self._Vocab()
 
     def encode(self, text, add_bos=True, add_special_tokens=True):
-        n = max(1, min(len(text), 12))
+        # long enough that the serving phase's identical prompts clear the
+        # scheduler's prefix_min_tokens=16, so admissions 2..8 exercise
+        # prefix caching in the measured number
+        n = max(1, min(len(text), 48))
         return [(7 + i) % self.vocab_size for i in range(n)]
 
     def make_stream_decoder(self):
@@ -470,6 +480,31 @@ def _phase_8b(platform):
     }
 
 
+def _phase_longctx(config, small):
+    """Decode throughput at FULL context: every step's attention reads the
+    whole KV cache (the long-context serving regime; reference analogue:
+    macbeth.sh's cache-filling generation). Measured with the bf16 KV
+    default AND --kv-dtype f8 — at long context the KV read is marginal
+    traffic alongside the weights, so f8 is a bandwidth lever there, not
+    just a capacity one. Cache CONTENTS are irrelevant to bandwidth, so
+    the cache starts zeroed at a high position (no prefill cost)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_short, n_long = (8, 16) if small else (16, 64)
+    start = config.seq_len - n_long - 1
+    params = jax.tree.map(jax.device_put, _random_packed_params(config))
+    out = {"longctx_context": start, "longctx_steps": n_long}
+
+    for name, dtype in (("bf16", jnp.bfloat16), ("f8", jnp.float8_e4m3fn)):
+        tok_s = _bench_decode(
+            config, params, n_short, n_long, reps=2,
+            tag=f"longctx-{name}kv", start_pos=start, cache_dtype=dtype,
+        )
+        out[f"longctx_decode_tok_s_{name}kv"] = round(tok_s, 2)
+    return out
+
+
 def _phase_parity(config, platform):
     """BASELINE.md's token-identity gate, measured with the SHIPPING TPU
     dtype: greedy-decode 256 tokens with the default bf16-dot kernel and
@@ -548,6 +583,8 @@ def child_main() -> None:
         result = _phase_8b(platform)
     elif phase == "parity":
         result = _phase_parity(config, platform)
+    elif phase == "longctx":
+        result = _phase_longctx(config, small)
     else:
         raise ValueError(f"unknown BENCH_PHASE {phase!r}")
     print(json.dumps(result), flush=True)
@@ -667,7 +704,7 @@ def main() -> None:
     # ablation diagnostics (the sweep below runs with whatever is left)
     for phase, cap in (
         ("serving", 420.0), ("8b", 500.0), ("parity", 300.0),
-        ("ablations", 420.0),
+        ("ablations", 420.0), ("longctx", 300.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)
         if budget < 90:

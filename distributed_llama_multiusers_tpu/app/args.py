@@ -78,10 +78,11 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
     p.add_argument("--no-spec", action="store_true",
                    help="disable prompt-lookup speculative decoding "
                         "(serving and greedy CLI inference)")
-    p.add_argument("--prefix-min-tokens", type=int, default=16,
+    p.add_argument("--prefix-min-tokens", type=int, default=None,
                    help="serving: reuse resident lane KV when a new "
                         "request shares at least this many leading prompt "
-                        "tokens (prefix caching); 0 disables")
+                        "tokens (prefix caching); 0 disables; default: "
+                        "scheduler default (16)")
     # train mode (beyond parity — no reference analogue)
     p.add_argument("--data", default=None,
                    help="train: UTF-8 text file tokenized into training batches")

@@ -165,9 +165,12 @@ def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
     t0 = time.perf_counter()
     warmup_engine(engine, spec=speculative)
     log("⏳", f"Warmup done in {time.perf_counter() - t0:.1f}s")
+    # pass prefix_min_tokens only when the CLI provided it: the scheduler
+    # default is the single source of truth for the fallback value
+    pmt = getattr(args, "prefix_min_tokens", None)
     sched = ContinuousBatchingScheduler(
         engine, tokenizer, speculative=speculative,
-        prefix_min_tokens=getattr(args, "prefix_min_tokens", 16),
+        **({} if pmt is None else {"prefix_min_tokens": pmt}),
     )
     sched.start()
     return sched
