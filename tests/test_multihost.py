@@ -97,18 +97,64 @@ SCHED_DRIVER = POD_PREAMBLE + textwrap.dedent(
     if mode == "root":
         eng = RootControlEngine(engine, plane)
         t = Tokenizer(os.path.join(tmp, "t.t"))
-        sched = ContinuousBatchingScheduler(eng, t)
-        sched.start()
-        req = Request(
-            prompt="hello world", max_tokens=6, temperature=0.7, seed=1234
-        )
-        sched.submit(req)
-        req.future.result(timeout=300)
-        sched.stop()
-        eng.stop_workers()
-        assert req.error is None, req.error
+        out = {{}}
+        try:
+            # stop_workers must run even when an assert below fails, or the
+            # worker blocks in plane.recv() until the harness timeout
+            sched = ContinuousBatchingScheduler(eng, t)
+            sched.start()
+            try:
+                req = Request(
+                    prompt="hello world", max_tokens=6, temperature=0.7,
+                    seed=1234,
+                )
+                sched.submit(req)
+                req.future.result(timeout=300)
+                assert req.error is None, req.error
+                # sequential greedy requests sharing a long prefix: the
+                # second admission prefix-hits (same lane -> the copy
+                # no-ops; the accounting still must fire on a pod root)
+                shared = "hello world hello world hello world hello wor "
+                outs = []
+                for tail in ("one", "two"):
+                    r = Request(
+                        prompt=shared + tail, max_tokens=4, temperature=0.0
+                    )
+                    sched.submit(r)
+                    r.future.result(timeout=300)
+                    assert r.error is None, r.error
+                    outs.append(r.generated_tokens)
+                assert eng.stats.prefix_hits >= 1, "pod prefix cache never hit"
+            finally:
+                sched.stop()
+            # CROSS-LANE prefix copy on the pod: lane 0 -> lane 1 rides an
+            # OP_COPY_LANE broadcast (src != dst), workers replay the same
+            # cache-copy program, and greedy decode continues on lane 1
+            # over the COPIED cache — parity asserted vs the one-process
+            # oracle below
+            ids = t.encode("hello world hello world")
+            _, g, pos = eng.prefill(0, ids)
+            eng.copy_lane(0, 1)
+            cur = int(g)
+            copied = [cur]
+            tvec = np.zeros(2, np.int32)
+            pvec = np.zeros(2, np.int32)
+            for _ in range(4):
+                tvec[1] = cur
+                pvec[1] = pos
+                _, gg, _ = eng.decode(tvec, pvec)
+                pos += 1
+                cur = int(gg[1])
+                copied.append(cur)
+            out = {{
+                "sampled": req.generated_tokens,
+                "prefix": outs,
+                "copy": copied,
+            }}
+        finally:
+            eng.stop_workers()
         with open(os.path.join(tmp, "root_sched_tokens.json"), "w") as f:
-            json.dump(req.generated_tokens, f)
+            json.dump(out, f)
     else:
         worker_serve(engine, plane, max_restarts=0)
     print(f"{{mode}} done", flush=True)
@@ -223,8 +269,8 @@ def test_two_process_pod_scheduler_sampled_matches_mesh(tmp_path):
     _run_pod(tmp, SCHED_DRIVER)
 
     with open(os.path.join(tmp, "root_sched_tokens.json")) as f:
-        pod_tokens = json.load(f)
-    assert len(pod_tokens) == 6
+        pod = json.load(f)
+    assert len(pod["sampled"]) == 6
 
     # single-process oracle: identical tp=2 mesh + scheduler + request
     import jax.numpy as jnp
@@ -253,10 +299,39 @@ def test_two_process_pod_scheduler_sampled_matches_mesh(tmp_path):
     req = Request(prompt="hello world", max_tokens=6, temperature=0.7, seed=1234)
     sched.submit(req)
     req.future.result(timeout=300)
-    sched.stop()
     assert req.error is None, req.error
+    # same sequential shared-prefix pair the pod root served
+    shared = "hello world hello world hello world hello wor "
+    outs = []
+    for tail in ("one", "two"):
+        r = Request(prompt=shared + tail, max_tokens=4, temperature=0.0)
+        sched.submit(r)
+        r.future.result(timeout=300)
+        assert r.error is None, r.error
+        outs.append(r.generated_tokens)
+    sched.stop()
 
-    assert pod_tokens == req.generated_tokens
+    # same cross-lane copy_lane + decode-on-copied-cache the pod root ran
+    import numpy as np
+
+    ids = t.encode("hello world hello world")
+    _, g, pos = engine.prefill(0, ids)
+    engine.copy_lane(0, 1)
+    cur = int(g)
+    copied = [cur]
+    tvec = np.zeros(2, np.int32)
+    pvec = np.zeros(2, np.int32)
+    for _ in range(4):
+        tvec[1] = cur
+        pvec[1] = pos
+        _, gg, _ = engine.decode(tvec, pvec)
+        pos += 1
+        cur = int(gg[1])
+        copied.append(cur)
+
+    assert pod["sampled"] == req.generated_tokens
+    assert pod["prefix"] == outs
+    assert pod["copy"] == copied, "pod cross-lane KV copy diverged"
 
 
 class _ScriptedPlane:
