@@ -130,6 +130,30 @@ class Trainer:
                 raise FileNotFoundError(f"no step_<N> checkpoints in {ckpt_dir}")
         step_dir = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
         ckpt = ocp.PyTreeCheckpointer()
+
+        def match_placement(restored, template):
+            if self.mesh is None:
+                # single-device: everything restores onto the one device
+                # anyway; skip the (full-model) host round-trip below
+                return restored
+            # orbax returns leaves COMMITTED to device 0 when the template
+            # carried no mesh sharding (optax scalar counters), which
+            # conflicts with mesh-sharded neighbors inside one jit. Mesh-
+            # sharded templates get their layout back via device_put;
+            # single-device templates (uncommitted by construction —
+            # optimizer.init output) get an uncommitted host round-trip so
+            # jit may place them wherever the computation runs.
+            import numpy as np
+            from jax.sharding import SingleDeviceSharding
+
+            def put(r, t):
+                sh = getattr(t, "sharding", None)
+                if sh is None or isinstance(sh, SingleDeviceSharding):
+                    return jnp.asarray(np.asarray(r))
+                return jax.device_put(r, sh)
+
+            return jax.tree.map(put, restored, template)
+
         # restore_args carry the templates' shardings, so a mesh-sharded
         # trainer resumes straight into its GSPMD layout (and the
         # "populating sharding from file" warning never applies)
@@ -145,8 +169,10 @@ class Trainer:
                     ),
                 )
 
-            self.params = load("params", self.params)
-            self.opt_state = load("opt_state", self.opt_state)
+            self.params = match_placement(load("params", self.params), self.params)
+            self.opt_state = match_placement(
+                load("opt_state", self.opt_state), self.opt_state
+            )
         else:
             template = {"params": self.params, "opt_state": self.opt_state}
             restored = ckpt.restore(
@@ -154,7 +180,9 @@ class Trainer:
                 item=template,
                 restore_args=ocp.checkpoint_utils.construct_restore_args(template),
             )
-            self.params = restored["params"]
-            self.opt_state = restored["opt_state"]
+            self.params = match_placement(restored["params"], self.params)
+            self.opt_state = match_placement(
+                restored["opt_state"], self.opt_state
+            )
         self.step_count = step
         return self
