@@ -30,6 +30,16 @@ def run_inference(args) -> None:
     prompt = args.prompt or "Hello"
     tokens = tokenizer.encode(prompt)
     log("📄", f"Prompt tokens: {len(tokens)}")
+    if len(tokens) >= config.seq_len:
+        # the reference asserts here (src/dllama.cpp eval loop); a clean
+        # exit beats its abort — the API server truncates instead. Note
+        # --max-seq-len only clamps DOWN, so it is not the remedy unless
+        # the window was previously clamped below the model max.
+        log("🚫", f"Prompt ({len(tokens)} tokens) does not fit the context "
+            f"window ({config.seq_len}); shorten the prompt")
+        if hasattr(engine, "stop_workers"):
+            engine.stop_workers()  # release pod workers before exiting
+        raise SystemExit(2)
     sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or 12345)
 
     t0 = time.perf_counter()
