@@ -132,6 +132,165 @@ def _random_packed_params(config, seed: int = 0, dtype=None):
     )
 
 
+def _assemble_params(config, t, cos, sin):
+    """Shared LlamaParams assembly for the on-device generators: ``t`` maps
+    weight names to device arrays; rms planes are ones; only the tiny RoPE
+    tables cross the host->device link."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.models.llama import (
+        LlamaLayerParams,
+        LlamaParams,
+    )
+
+    L, d = config.n_layers, config.dim
+    layers = LlamaLayerParams(
+        wq=t["wq"], wk=t["wk"], wv=t["wv"], wo=t["wo"],
+        w1=t["w1"], w2=t["w2"], w3=t["w3"],
+        rms_att=jnp.ones((L, d), jnp.float32),
+        rms_ffn=jnp.ones((L, d), jnp.float32),
+        moe_gate=t.get("moe_gate"),
+    )
+    return LlamaParams(
+        embedding=t["embedding"],
+        layers=layers,
+        rms_final=jnp.ones((d,), jnp.float32),
+        wcls=t["wcls"],
+        rope_cos=jax.device_put(cos),
+        rope_sin=jax.device_put(sin),
+    )
+
+
+def _device_packed_params(config, seed: int = 0, dtype=None):
+    """Random PackedQ40 params generated ON DEVICE in one jitted program.
+
+    Over the axon device tunnel, `device_put` of the 0.7 GB (1B) / 4.3 GB
+    (8B) host planes is the dominant setup cost — and heavy bulk transfer
+    is the prime suspect for the tunnel wedging mid-round (rounds 4-5 both
+    lost it right after a multi-hundred-MB put). Values are irrelevant to a
+    bandwidth benchmark; on-chip random bits have identical shapes/bytes
+    and cost zero host->device traffic (only the tiny RoPE tables cross)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.models.loader import _rope_cache
+    from distributed_llama_multiusers_tpu.quants.packed import (
+        PackedQ40,
+        padded_d_out,
+    )
+
+    if dtype is None:
+        dtype = jnp.bfloat16
+    L, d, h = config.n_layers, config.dim, config.hidden_dim
+    kv = config.n_kv_heads * config.head_size
+    e = (config.n_experts,) if config.n_experts > 0 else ()
+    specs = {
+        "wq": (d, d, (L,)),
+        "wk": (d, kv, (L,)),
+        "wv": (d, kv, (L,)),
+        "wo": (d, d, (L,)),
+        "w1": (d, h, (L, *e)),
+        "w2": (h, d, (L, *e)),
+        "w3": (d, h, (L, *e)),
+        "wcls": (d, padded_d_out(config.vocab_size), ()),
+    }
+
+    def gen(key):
+        out = {}
+        for name, (d_in, d_out, lead) in specs.items():
+            key, kp, ks = jax.random.split(key, 3)
+            pk = jax.random.bits(kp, (*lead, d_in // 2, d_out), jnp.uint8)
+            sc = (
+                jax.random.uniform(ks, (*lead, d_in // 32, d_out), jnp.float32)
+                * 0.01 + 0.001
+            )
+            if name == "wcls" and d_out > config.vocab_size:
+                # keep the loader's invariant: zero scales make the vocab
+                # pad columns dequantize to exact zeros
+                sc = jnp.where(
+                    jnp.arange(d_out) < config.vocab_size, sc, 0.0
+                )
+            out[name] = PackedQ40(packed=pk, scales=sc.astype(jnp.float16))
+        key, ke, kg = jax.random.split(key, 3)
+        out["embedding"] = (
+            jax.random.normal(ke, (config.vocab_size, d), jnp.float32) * 0.02
+        ).astype(dtype)
+        if config.n_experts > 0:
+            out["moe_gate"] = jax.random.normal(
+                kg, (L, d, config.n_experts), jnp.float32
+            )
+        return out
+
+    t = jax.jit(gen)(jax.random.PRNGKey(seed))
+    jax.block_until_ready(t)
+    return _assemble_params(config, t, *_rope_cache(config))
+
+
+def _device_dense_params(config, seed: int = 0, dtype=None):
+    """Dense random params generated on device (see _device_packed_params
+    for why): the 1B bf16 tree is ~2.5 GB — never ship that over the
+    tunnel for an ablation."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.models.loader import _rope_cache
+
+    if dtype is None:
+        dtype = jnp.bfloat16
+    L, d, h = config.n_layers, config.dim, config.hidden_dim
+    kv = config.n_kv_heads * config.head_size
+    e = (config.n_experts,) if config.n_experts > 0 else ()
+    specs = {
+        "wq": (L, d, d), "wk": (L, d, kv), "wv": (L, d, kv), "wo": (L, d, d),
+        "w1": (L, *e, d, h), "w2": (L, *e, h, d), "w3": (L, *e, d, h),
+        "embedding": (config.vocab_size, d), "wcls": (d, config.vocab_size),
+    }
+
+    def gen(key):
+        out = {}
+        for name, shape in specs.items():
+            key, k1 = jax.random.split(key)
+            out[name] = (jax.random.normal(k1, shape, jnp.float32) * 0.02).astype(dtype)
+        if config.n_experts > 0:
+            key, kg = jax.random.split(key)
+            out["moe_gate"] = jax.random.normal(
+                kg, (L, d, config.n_experts), jnp.float32
+            )
+        return out
+
+    t = jax.jit(gen)(jax.random.PRNGKey(seed))
+    jax.block_until_ready(t)
+    return _assemble_params(config, t, *_rope_cache(config))
+
+
+def _resident_packed_params(config, seed: int = 0):
+    """Device-resident PackedQ40 params by the cheapest route for the
+    backend: on-chip generation on TPU (zero bulk host->device traffic —
+    the tunnel is slow and fragile under load), host numpy + device_put on
+    CPU (threefry on XLA:CPU is slower than one memcpy)."""
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        return _device_packed_params(config, seed)
+    return jax.tree.map(jax.device_put, _random_packed_params(config, seed))
+
+
+def _resident_dense_params(config, seed: int = 0, dtype=None):
+    """Dense twin of _resident_packed_params (same backend dispatch)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_multiusers_tpu.models import params_from_random
+
+    if dtype is None:
+        dtype = jnp.bfloat16
+    if jax.devices()[0].platform == "tpu":
+        return _device_dense_params(config, seed, dtype)
+    host = params_from_random(config, seed=seed, dtype=dtype, to_device=False)
+    return jax.tree.map(jax.device_put, host)
+
+
 def _tree_device_bytes(tree) -> int:
     import jax
 
@@ -274,7 +433,7 @@ def _phase_primary(config, platform, device_kind, small):
 
     n_short, n_long = (4, 16) if small else (16, 128)
     t0 = time.perf_counter()
-    params_q = jax.tree.map(jax.device_put, _random_packed_params(config))
+    params_q = _resident_packed_params(config)
     print(f"[bench] packed params resident in {time.perf_counter()-t0:.1f}s "
           f"({_tree_device_bytes(params_q)/1e9:.2f} GB)", file=sys.stderr, flush=True)
 
@@ -335,7 +494,7 @@ def _phase_serving(config, small):
         Request,
     )
 
-    params = jax.tree.map(jax.device_put, _random_packed_params(config))
+    params = _resident_packed_params(config)
     n_lanes = 8
     max_tokens = 12 if small else 48
     engine = InferenceEngine(
@@ -421,13 +580,11 @@ def _phase_ablations(config, small):
     import jax
     import jax.numpy as jnp
 
-    from distributed_llama_multiusers_tpu.models import params_from_random
-    from distributed_llama_multiusers_tpu.models.loader import quantize_params
     from distributed_llama_multiusers_tpu.ops import linear
 
     n_short, n_long = (4, 16) if small else (16, 128)
     out = {}
-    params_q = jax.tree.map(jax.device_put, _random_packed_params(config))
+    params_q = _resident_packed_params(config)
     linear.set_pallas_enabled(False)
     try:
         out["ablation_xla_dequant_tok_s"] = round(
@@ -446,9 +603,7 @@ def _phase_ablations(config, small):
     finally:
         linear.set_pallas_w_dtype(None)
     del params_q
-    host_dense = params_from_random(config, seed=0, dtype=jnp.bfloat16, to_device=False)
-    params_d = jax.tree.map(jax.device_put, host_dense)
-    del host_dense
+    params_d = _resident_dense_params(config, seed=0, dtype=jnp.bfloat16)
     out["ablation_dense_bf16_tok_s"] = round(
         _bench_decode(config, params_d, n_short, n_long, tag="dense-bf16"), 2
     )
@@ -470,7 +625,7 @@ def _phase_8b(platform):
     import jax
 
     t0 = time.perf_counter()
-    params8 = jax.tree.map(jax.device_put, _random_packed_params(cfg8))
+    params8 = _resident_packed_params(cfg8)
     print(f"[bench] 8B packed params resident in {time.perf_counter()-t0:.1f}s "
           f"({_tree_device_bytes(params8)/1e9:.2f} GB)", file=sys.stderr, flush=True)
     tok8 = _bench_decode(cfg8, params8, 8, 64, reps=2, tag="8b packed+pallas")
@@ -493,7 +648,7 @@ def _phase_longctx(config, small):
 
     n_short, n_long = (8, 16) if small else (16, 64)
     start = config.seq_len - n_long - 1
-    params = jax.tree.map(jax.device_put, _random_packed_params(config))
+    params = _resident_packed_params(config)
     out = {"longctx_context": start, "longctx_steps": n_long}
 
     for name, dtype in (("bf16", jnp.bfloat16), ("f8", jnp.float8_e4m3fn)):
@@ -524,7 +679,7 @@ def _phase_parity(config, platform):
     from distributed_llama_multiusers_tpu.runtime import InferenceEngine
     from distributed_llama_multiusers_tpu.utils.testing import greedy_rollout
 
-    params = jax.tree.map(jax.device_put, _random_packed_params(config))
+    params = _resident_packed_params(config)
     prompt = list(range(1, 17))
     n = 256
     streams = {}

@@ -140,6 +140,17 @@ def pallas_wide_tile(d_out: int) -> int | None:
 PAD_MAX_OVERHEAD = 0.125  # never inflate a tensor's bytes by more than this
 
 
+def padded_d_out(d_out: int) -> int:
+    """The output width pad_packed_d_out would pad a tensor of width
+    ``d_out`` to (shape-only: lets benchmarks draw padded planes directly
+    on device without materializing the unpadded host tensor)."""
+    tile = pallas_wide_tile(d_out)
+    if d_out <= PALLAS_W_MAX or (tile is not None and tile >= 4096):
+        return d_out
+    pad = -d_out % PALLAS_W_MAX
+    return d_out if pad > d_out * PAD_MAX_OVERHEAD else d_out + pad
+
+
 def pad_packed_d_out(packed: np.ndarray, scales: np.ndarray):
     """Zero-pad a packed weight's OUTPUT dim to a multiple of 8192 when the
     slab kernel cannot tile it WELL (e.g. vocab 128256: best natural tile
@@ -155,12 +166,10 @@ def pad_packed_d_out(packed: np.ndarray, scales: np.ndarray):
     and take the narrow-tile or q40_matmul_xla path instead. Pads that do
     land are logged so the inflation is visible."""
     d_out = packed.shape[-1]
-    tile = pallas_wide_tile(d_out)
-    if d_out <= PALLAS_W_MAX or (tile is not None and tile >= 4096):
+    target = padded_d_out(d_out)
+    if target == d_out:
         return packed, scales
-    pad = -d_out % PALLAS_W_MAX
-    if pad > d_out * PAD_MAX_OVERHEAD:
-        return packed, scales
+    pad = target - d_out
     import logging
 
     logging.getLogger(__name__).info(
