@@ -74,6 +74,25 @@ def _chip_spec(device_kind: str):
 # ---------------------------------------------------------------------------
 
 
+def _weight_specs(config):
+    """(d_in, d_out, lead) per matmul weight (wcls/embedding handled by
+    callers) — the single shape table every bench param generator draws
+    from, so the TPU on-device path, the CPU host path, and the dense
+    ablation cannot drift apart."""
+    L, d, h = config.n_layers, config.dim, config.hidden_dim
+    kv = config.n_kv_heads * config.head_size
+    e = (config.n_experts,) if config.n_experts > 0 else ()
+    return {
+        "wq": (d, d, (L,)),
+        "wk": (d, kv, (L,)),
+        "wv": (d, kv, (L,)),
+        "wo": (d, d, (L,)),
+        "w1": (d, h, (L, *e)),
+        "w2": (h, d, (L, *e)),
+        "w3": (d, h, (L, *e)),
+    }
+
+
 def _random_packed_params(config, seed: int = 0, dtype=None):
     """Random PackedQ40 params WITHOUT the dense host intermediate: the
     packed nibble/scale planes are drawn directly (values are irrelevant to
@@ -92,8 +111,7 @@ def _random_packed_params(config, seed: int = 0, dtype=None):
     if dtype is None:
         dtype = jnp.bfloat16
     rng = np.random.default_rng(seed)
-    L, d, h = config.n_layers, config.dim, config.hidden_dim
-    kv = config.n_kv_heads * config.head_size
+    L, d = config.n_layers, config.dim
 
     from distributed_llama_multiusers_tpu.quants.packed import pad_packed_d_out
 
@@ -106,15 +124,15 @@ def _random_packed_params(config, seed: int = 0, dtype=None):
             pk, sc = pad_packed_d_out(pk, sc)
         return PackedQ40(packed=pk, scales=sc)
 
-    e = (config.n_experts,) if config.n_experts > 0 else ()
+    w = {k: packed(*s[:2], s[2]) for k, s in _weight_specs(config).items()}
     layers = LlamaLayerParams(
-        wq=packed(d, d, (L,)),
-        wk=packed(d, kv, (L,)),
-        wv=packed(d, kv, (L,)),
-        wo=packed(d, d, (L,)),
-        w1=packed(d, h, (L, *e)),
-        w2=packed(h, d, (L, *e)),
-        w3=packed(d, h, (L, *e)),
+        wq=w["wq"],
+        wk=w["wk"],
+        wv=w["wv"],
+        wo=w["wo"],
+        w1=w["w1"],
+        w2=w["w2"],
+        w3=w["w3"],
         rms_att=np.ones((L, d), np.float32),
         rms_ffn=np.ones((L, d), np.float32),
         moe_gate=(rng.standard_normal((L, d, config.n_experts), dtype=np.float32)
@@ -182,19 +200,9 @@ def _device_packed_params(config, seed: int = 0, dtype=None):
 
     if dtype is None:
         dtype = jnp.bfloat16
-    L, d, h = config.n_layers, config.dim, config.hidden_dim
-    kv = config.n_kv_heads * config.head_size
-    e = (config.n_experts,) if config.n_experts > 0 else ()
-    specs = {
-        "wq": (d, d, (L,)),
-        "wk": (d, kv, (L,)),
-        "wv": (d, kv, (L,)),
-        "wo": (d, d, (L,)),
-        "w1": (d, h, (L, *e)),
-        "w2": (h, d, (L, *e)),
-        "w3": (d, h, (L, *e)),
-        "wcls": (d, padded_d_out(config.vocab_size), ()),
-    }
+    L, d = config.n_layers, config.dim
+    specs = dict(_weight_specs(config))
+    specs["wcls"] = (d, padded_d_out(config.vocab_size), ())
 
     def gen(key):
         out = {}
@@ -238,14 +246,13 @@ def _device_dense_params(config, seed: int = 0, dtype=None):
 
     if dtype is None:
         dtype = jnp.bfloat16
-    L, d, h = config.n_layers, config.dim, config.hidden_dim
-    kv = config.n_kv_heads * config.head_size
-    e = (config.n_experts,) if config.n_experts > 0 else ()
+    L, d = config.n_layers, config.dim
     specs = {
-        "wq": (L, d, d), "wk": (L, d, kv), "wv": (L, d, kv), "wo": (L, d, d),
-        "w1": (L, *e, d, h), "w2": (L, *e, h, d), "w3": (L, *e, d, h),
-        "embedding": (config.vocab_size, d), "wcls": (d, config.vocab_size),
+        k: (*lead, d_in, d_out)
+        for k, (d_in, d_out, lead) in _weight_specs(config).items()
     }
+    specs["embedding"] = (config.vocab_size, d)
+    specs["wcls"] = (d, config.vocab_size)
 
     def gen(key):
         out = {}

@@ -28,9 +28,6 @@ from jax.experimental.pallas import tpu as pltpu
 
 sys.path.insert(0, ".")
 
-from distributed_llama_multiusers_tpu.quants.packed import (  # noqa: E402
-    pack_q40_host,
-)
 from distributed_llama_multiusers_tpu.ops.pallas_q40 import (  # noqa: E402
     _f16_bits_to_f32,
 )
@@ -178,15 +175,19 @@ def main():
     L = int(sys.argv[3]) if len(sys.argv) > 3 else 8
     _REPS = int(sys.argv[4]) if len(sys.argv) > 4 else 8
 
-    rng = np.random.default_rng(0)
-    # one packed plane replicated L times: timing only cares about bytes
-    w = (rng.standard_normal((d_out, d_in), dtype=np.float32) * 0.05)
-    p, s = pack_q40_host(w)
-    packed = jnp.asarray(np.broadcast_to(p, (L, *p.shape)))  # [L, half, d_out]
-    sbits = jax.lax.bitcast_convert_type(
-        jnp.asarray(np.broadcast_to(s, (L, *s.shape))), jnp.int16
-    )
+    # Draw the planes ON DEVICE: timing only cares about bytes, and bulk
+    # device_put over the axon tunnel is slow enough to wedge it (both
+    # round-4/5 outages followed a multi-hundred-MB put). Only scalars
+    # cross the link.
     half = d_in // 2
+    kp, ks = jax.random.split(jax.random.PRNGKey(0))
+    packed = jax.random.bits(kp, (L, half, d_out), jnp.uint8)
+    scales = (
+        jax.random.uniform(ks, (L, d_in // 32, d_out), jnp.float32) * 0.01
+        + 0.001
+    ).astype(jnp.float16)
+    sbits = jax.lax.bitcast_convert_type(scales, jnp.int16)
+    jax.block_until_ready((packed, sbits))
     pbytes = packed.size
     print(f"d_in={d_in} d_out={d_out} L={L} packed={pbytes / 1e6:.1f} MB "
           f"device={jax.devices()[0].device_kind}", flush=True)
@@ -258,11 +259,13 @@ def main():
     timeit("u32 +convert_bf16", staged32(partial(_k32_conv, dt=jnp.bfloat16)), pbytes)
 
     # MXU stream reference: dot over pre-dequantized planes at same shapes
-    x = jnp.asarray(rng.standard_normal((M, d_in), dtype=np.float32))
+    kx = jax.random.PRNGKey(1)
+    x = jax.random.normal(kx, (M, d_in), jnp.float32)
     for dt, tag in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
-        wd = jnp.asarray(
-            rng.standard_normal((L, d_in, d_out), dtype=np.float32), dtype=dt
-        )
+        wd = jax.random.normal(
+            jax.random.PRNGKey(2), (L, d_in, d_out), jnp.float32
+        ).astype(dt)
+        jax.block_until_ready(wd)
         x_spec = pl.BlockSpec((M, CHUNK), lambda l, j, k: (0, k))
         w_spec = pl.BlockSpec((1, CHUNK, TILE), lambda l, j, k: (l, k, j))
         od_spec = pl.BlockSpec((M, TILE), lambda l, j, k: (0, j))
@@ -284,7 +287,7 @@ def main():
         del wd
 
     # full two-dot kernel (current product formulation), f32 and bf16 planes
-    xf = jnp.asarray(rng.standard_normal((M, d_in), dtype=np.float32))
+    xf = jax.random.normal(jax.random.PRNGKey(3), (M, d_in), jnp.float32)
     xb = xf.reshape(M, d_in // 32, 2, 16)
     x_lo = xb[:, :, 0, :].reshape(M, half)
     x_hi = xb[:, :, 1, :].reshape(M, half)
