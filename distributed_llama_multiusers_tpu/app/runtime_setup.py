@@ -46,6 +46,36 @@ def honor_cpu_platform_env() -> None:
     force_cpu_mesh(n_devices=int(m.group(1)) if m else 1)
 
 
+def enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache (opt-out: DLLAMA_NO_COMPILE_CACHE=1).
+
+    First TPU compiles over this box's device tunnel cost tens of seconds;
+    the cache makes repeat builds of the same programs (bench phase
+    children, CLI restarts, pod workers replaying identical programs)
+    near-instant across processes. Kernel-geometry env knobs are safe: they
+    change the serialized Mosaic kernel inside the HLO, so the cache key
+    differs. Backends that cannot serialize executables degrade to a no-op
+    inside JAX; the cache is an optimization, never fatal."""
+    import os
+
+    if os.environ.get("DLLAMA_NO_COMPILE_CACHE") == "1":
+        return
+    path = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "dllama_xla"),
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001
+        print(
+            f"⚠️ compilation cache disabled ({type(e).__name__}: {e})",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
 def load_stack(args, n_lanes: int | None = None):
     """Returns (config, params, tokenizer, engine).
 
@@ -57,6 +87,7 @@ def load_stack(args, n_lanes: int | None = None):
     (reference: src/nn/nn-network.cpp:824-901)."""
     from ..parallel.multihost import maybe_initialize_distributed
 
+    enable_compilation_cache()
     n_proc = maybe_initialize_distributed(args)
     if not args.model or not args.tokenizer:
         print("error: --model and --tokenizer are required", file=sys.stderr)

@@ -75,7 +75,12 @@ SWEEP_COMBOS = {
     "slab2M_blk2M": (2 << 20, 2 << 20),
     "slab4M_blk2M": (4 << 20, 2 << 20),
     "slab4M_blk4M": (4 << 20, 4 << 20),
-    "slab512k_blk512k": (512 << 10, 512 << 10),
+    # whole-plane single DMA for every 1B plane (w1/w3 are 8 MB packed):
+    # trades k-loop double-buffer overlap for zero chunking overhead.
+    # (A 512k combo was dropped: blocks under 1 MB cannot tile the
+    # 8192-wide FFN planes at all — rows would have to be <128 — so it
+    # silently measured the XLA fallback, not the kernel.)
+    "slab8M_blk8M": (8 << 20, 8 << 20),
 }
 DEFAULT_COMBO = "slab1M_blk1M"
 M_TILE = 256

@@ -1,0 +1,61 @@
+#!/bin/bash
+# Supervisor for the round's hardware evidence: wait for the TPU tunnel,
+# then bank proof in VALUE order — bench.py artifact first (primary +
+# serving + 8B north star + bf16 parity + longctx + in-bench sweep),
+# then the standalone kernel sweep, then the stage probe. If the tunnel
+# dies mid-attempt, go back to waiting; stop once a TPU-platform bench
+# artifact is banked (BENCH_LIVE.json) or the deadline passes.
+#
+# Writes results under scripts/hw_evidence_<ts>/; never touches git (the
+# foreground session commits banked artifacts to avoid index races).
+set -u
+DIR="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(dirname "$DIR")"
+cd "$REPO"
+DEADLINE=$(( $(date +%s) + ${EVIDENCE_MAX_S:-36000} ))
+
+is_tpu_artifact() {  # $1 = bench stdout file
+  python - "$1" <<'EOF'
+import json, sys
+plat = None
+try:
+    for line in open(sys.argv[1]):
+        line = line.strip()
+        if line.startswith("{"):
+            plat = json.loads(line).get("platform")
+except Exception:
+    pass
+sys.exit(0 if plat == "tpu" else 1)
+EOF
+}
+
+attempt=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  attempt=$((attempt + 1))
+  TPU_PROBE_TIMEOUT_S=150 TPU_PROBE_INTERVAL_S=180 bash scripts/tpu_watch.sh || exit 1
+  TS=$(date +%Y%m%d_%H%M%S)
+  OUT="$DIR/hw_evidence_$TS"
+  mkdir -p "$OUT"
+  echo "attempt $attempt: tunnel alive, benching" > "$OUT/status"
+
+  BENCH_DEADLINE=${BENCH_DEADLINE:-2400} timeout 2600 python bench.py \
+    > "$OUT/bench.out" 2> "$OUT/bench.err"
+  echo "bench rc=$?" >> "$OUT/status"
+  if is_tpu_artifact "$OUT/bench.out"; then
+    tail -1 "$OUT/bench.out" > "$REPO/BENCH_LIVE.json"
+    echo "TPU artifact banked" >> "$OUT/status"
+    # bonus evidence while the tunnel is up; each has its own timeout
+    timeout "${SWEEP_BUDGET_S:-1200}" python scripts/kernel_sweep.py 240 \
+      > "$OUT/kernel_sweep.log" 2>&1
+    echo "kernel_sweep rc=$?" >> "$OUT/status"
+    timeout "${PROBE_BUDGET_S:-600}" python scripts/stage_probe.py \
+      > "$OUT/stage_probe.log" 2>&1
+    echo "stage_probe rc=$?" >> "$OUT/status"
+    echo DONE >> "$OUT/status"
+    exit 0
+  fi
+  echo "no TPU artifact (tunnel died or CPU fallback); re-waiting" >> "$OUT/status"
+  sleep 30
+done
+echo "evidence loop: deadline passed"
+exit 1
