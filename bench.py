@@ -907,8 +907,8 @@ def main() -> None:
             (n, s, b) for n, (s, b) in SWEEP_COMBOS.items()
             if n != DEFAULT_COMBO
         ]
-        combos = non_default[:3]
-        for n, _, _ in non_default[3:]:  # no silent caps
+        combos = non_default[:4]
+        for n, _, _ in non_default[4:]:  # no silent caps
             errors.append(f"sweep[{n}]: skipped (combo cap)")
         for name, slab, blk in combos:
             budget = min(300.0, deadline - time.monotonic() - 10)

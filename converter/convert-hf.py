@@ -69,9 +69,14 @@ class SafetensorsIndex:
 def load_config(folder: str, weight_type: int) -> tuple[ModelHeader, dict]:
     with open(os.path.join(folder, "config.json")) as f:
         cfg = json.load(f)
-    arch = {"llama": ArchType.LLAMA, "mistral": ArchType.LLAMA, "mixtral": ArchType.LLAMA}.get(
-        cfg["model_type"]
-    )
+    # qwen2 is the llama graph + q/k/v projection biases (KEY_QKV_BIAS;
+    # detected from the checkpoint tensors in convert())
+    arch = {
+        "llama": ArchType.LLAMA,
+        "mistral": ArchType.LLAMA,
+        "mixtral": ArchType.LLAMA,
+        "qwen2": ArchType.LLAMA,
+    }.get(cfg["model_type"])
     if arch is None:
         raise ValueError(f"Unsupported arch type: {cfg['model_type']}")
     act = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}.get(cfg["hidden_act"])
@@ -122,6 +127,13 @@ def convert(folder: str, weight_type: int, out_path: str) -> None:
     index = SafetensorsIndex(files)
     wt = weight_type
     n_heads, n_kv = header.n_heads, header.n_kv_heads
+    # Qwen2-family checkpoints (and llama-arch configs with
+    # attention_bias=true) carry q/k/v projection biases
+    header.qkv_bias = int("model.layers.0.self_attn.q_proj.bias" in index)
+
+    def bias_permuted(key: str, heads: int) -> np.ndarray:
+        # same head-dim rotary relayout as the weight, applied to the vector
+        return permute_rotary(index.get(key).reshape(-1, 1), heads).reshape(-1)
 
     with open(out_path, "wb") as out:
         write_header(out, header)
@@ -129,8 +141,14 @@ def convert(folder: str, weight_type: int, out_path: str) -> None:
         for l in range(header.n_layers):
             pre = f"model.layers.{l}"
             write_tensor(out, permute_rotary(index.get(f"{pre}.self_attn.q_proj.weight"), n_heads), wt)
+            if header.qkv_bias:
+                write_tensor(out, bias_permuted(f"{pre}.self_attn.q_proj.bias", n_heads), FloatType.F32)
             write_tensor(out, permute_rotary(index.get(f"{pre}.self_attn.k_proj.weight"), n_kv), wt)
+            if header.qkv_bias:
+                write_tensor(out, bias_permuted(f"{pre}.self_attn.k_proj.bias", n_kv), FloatType.F32)
             write_tensor(out, index.get(f"{pre}.self_attn.v_proj.weight"), wt)
+            if header.qkv_bias:
+                write_tensor(out, index.get(f"{pre}.self_attn.v_proj.bias"), FloatType.F32)
             write_tensor(out, index.get(f"{pre}.self_attn.o_proj.weight"), wt)
             if header.n_experts > 0:
                 # router (framework extension: the reference converter drops

@@ -58,7 +58,8 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "f32 on CPU; f8 = float8_e4m3 storage (quarter the "
                         "f32 HBM — double the lanes or context per chip; "
                         "dequant fuses into the attention reads)")
-    p.add_argument("--chat-template", default=None, choices=[None, "llama2", "llama3", "deepSeek3"])
+    p.add_argument("--chat-template", default=None,
+                   choices=[None, "llama2", "llama3", "deepSeek3", "chatml"])
     p.add_argument("--workers", nargs="*", default=None,
                    help="TPU: device count or mesh spec (dp2,tp4); reference compat")
     # multi-host pod bootstrap (reference: worker serve() + root connect,

@@ -51,6 +51,11 @@ KEY_ROPE_SCALING_LOW_FREQ_FACTOR = 15
 KEY_ROPE_SCALING_HIGH_FREQ_FACTORY = 16
 KEY_ROPE_SCALING_ORIG_MAX_SEQ_LEN = 17
 KEY_ROPE_TYPE = 18
+# framework extension (reference enum src/llm.hpp:8-28 stops at 18):
+# nonzero = per-layer q/k/v bias vectors follow each q/k/v matmul tensor
+# (Qwen2-family checkpoints). Readers of bias-free files never see the key,
+# so every pre-extension .m stays byte-identical.
+KEY_QKV_BIAS = 19
 
 
 class ArchType:
@@ -92,6 +97,7 @@ class ModelHeader:
     rope_scaling_high_freq_factor: float = 0.0
     rope_scaling_orig_max_seq_len: int = 0
     rope_type: int = RopeType.LLAMA
+    qkv_bias: int = 0  # Qwen2-family q/k/v bias vectors (KEY_QKV_BIAS)
     norm_epsilon: float = 1e-5
     header_size: int = 0
     file_size: int = 0
@@ -126,7 +132,7 @@ class ModelHeader:
             (KEY_ROPE_SCALING_HIGH_FREQ_FACTORY, int(self.rope_scaling_high_freq_factor)),
             (KEY_ROPE_SCALING_ORIG_MAX_SEQ_LEN, self.rope_scaling_orig_max_seq_len),
             (KEY_ROPE_TYPE, self.rope_type),
-        ]
+        ] + ([(KEY_QKV_BIAS, self.qkv_bias)] if self.qkv_bias else [])
 
 
 def write_model_header(f: BinaryIO, header: ModelHeader) -> int:
@@ -192,6 +198,8 @@ def load_model_header(path: str, max_seq_len: int = 0) -> ModelHeader:
                 h.rope_scaling_orig_max_seq_len = value
             elif key == KEY_ROPE_TYPE:
                 h.rope_type = value
+            elif key == KEY_QKV_BIAS:
+                h.qkv_bias = value
             else:
                 raise ValueError(f"Unsupported header key {key}")
         if h.weight_type == -1:
@@ -241,8 +249,14 @@ def model_tensor_specs(h: ModelHeader) -> list[TensorSpec]:
     add("embedding", 0, FloatType.F32, (vocab, dim))
     for l in range(h.n_layers):
         add("block_matmul_q", l, wt, (dim, dim))
+        if h.qkv_bias:
+            add("block_bias_q", l, FloatType.F32, (1, dim))
         add("block_matmul_k", l, wt, (kv_dim, dim))
+        if h.qkv_bias:
+            add("block_bias_k", l, FloatType.F32, (1, kv_dim))
         add("block_matmul_v", l, wt, (kv_dim, dim))
+        if h.qkv_bias:
+            add("block_bias_v", l, FloatType.F32, (1, kv_dim))
         add("block_matmul_wo", l, wt, (dim, dim))
         if h.n_experts > 0:
             add("block_moe_gate", l, FloatType.F32, (h.n_experts, dim))

@@ -26,8 +26,10 @@ def tiny_header(
     rope_theta: float = 10000.0,
     n_experts: int = 0,
     n_active_experts: int = 0,
+    qkv_bias: int = 0,
 ) -> ModelHeader:
     h = ModelHeader(
+        qkv_bias=qkv_bias,
         version=0,
         arch_type=ArchType.LLAMA,
         dim=dim,
@@ -81,8 +83,14 @@ def write_synthetic_model(path: str, header: ModelHeader, seed: int = 0, scale: 
         _write_tensor(f, rand((vocab, dim)), FloatType.F32)
         for _ in range(header.n_layers):
             _write_tensor(f, rand((dim, dim)), wt)  # q
+            if header.qkv_bias:
+                _write_tensor(f, rand((dim,)), FloatType.F32)  # bq
             _write_tensor(f, rand((kv_dim, dim)), wt)  # k
+            if header.qkv_bias:
+                _write_tensor(f, rand((kv_dim,)), FloatType.F32)  # bk
             _write_tensor(f, rand((kv_dim, dim)), wt)  # v
+            if header.qkv_bias:
+                _write_tensor(f, rand((kv_dim,)), FloatType.F32)  # bv
             _write_tensor(f, rand((dim, dim)), wt)  # wo
             if header.n_experts > 0:
                 _write_tensor(f, rand((header.n_experts, dim)), FloatType.F32)  # router

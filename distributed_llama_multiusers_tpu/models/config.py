@@ -26,6 +26,7 @@ class LlamaConfig:
     norm_epsilon: float = 1e-5
     n_experts: int = 0
     n_active_experts: int = 0
+    qkv_bias: int = 0  # Qwen2-family: add per-layer q/k/v projection biases
 
     def __post_init__(self):
         if self.n_experts > 0 and not (1 <= self.n_active_experts <= self.n_experts):
@@ -62,4 +63,5 @@ class LlamaConfig:
             norm_epsilon=h.norm_epsilon,
             n_experts=h.n_experts,
             n_active_experts=h.n_active_experts,
+            qkv_bias=h.qkv_bias,
         )

@@ -57,6 +57,12 @@ class LlamaLayerParams(NamedTuple):
     rms_att: jnp.ndarray  # [L, dim]
     rms_ffn: jnp.ndarray  # [L, dim]
     moe_gate: jnp.ndarray | None = None  # [L, dim, n_experts] router, f32
+    # Qwen2-family q/k/v projection biases (config.qkv_bias); None for the
+    # Llama/Mistral/Mixtral families. Added to the matmul outputs BEFORE
+    # RoPE, matching the HF formulation.
+    bq: jnp.ndarray | None = None  # [L, dim]
+    bk: jnp.ndarray | None = None  # [L, kv_dim]
+    bv: jnp.ndarray | None = None  # [L, kv_dim]
 
 
 class LlamaParams(NamedTuple):
@@ -87,6 +93,14 @@ def _to_cache_dtype(x: jnp.ndarray, dtype) -> jnp.ndarray:
         lim = float(jnp.finfo(dtype).max)
         x = jnp.clip(x, -lim, lim)
     return x.astype(dtype)
+
+
+def _maybe_bias(y: jnp.ndarray, b: jnp.ndarray | None) -> jnp.ndarray:
+    """Add a per-layer projection bias when present (Qwen2-family q/k/v,
+    config.qkv_bias); identity for the bias-free families."""
+    if b is None:
+        return y
+    return y + b.astype(y.dtype)
 
 
 def _qdq_q80(x: jnp.ndarray) -> jnp.ndarray:
@@ -339,9 +353,9 @@ def llama_forward(
 
         y = rms_norm(x, lp.rms_att, eps)
         yq = maybe_qdq(y)
-        q = matmul(yq, lp.wq).reshape(b, t, n_heads, hd)
-        k = matmul(yq, lp.wk).reshape(b, t, n_kv, hd)
-        v = matmul(yq, lp.wv).reshape(b, t, n_kv, hd)
+        q = _maybe_bias(matmul(yq, lp.wq), lp.bq).reshape(b, t, n_heads, hd)
+        k = _maybe_bias(matmul(yq, lp.wk), lp.bk).reshape(b, t, n_kv, hd)
+        v = _maybe_bias(matmul(yq, lp.wv), lp.bv).reshape(b, t, n_kv, hd)
 
         q = apply_rope(q, params.rope_cos, params.rope_sin, positions)
         k = apply_rope(k, params.rope_cos, params.rope_sin, positions)
@@ -456,9 +470,9 @@ def train_layer_step_fn(config: LlamaConfig, rope_cos, rope_sin, mesh=None,
         dtype = x.dtype
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
         y = rms_norm(x, lp.rms_att, eps)
-        q = matmul(y, lp.wq).reshape(b, t, n_heads, hd)
-        k = matmul(y, lp.wk).reshape(b, t, n_kv, hd)
-        v = matmul(y, lp.wv).reshape(b, t, n_kv, hd)
+        q = _maybe_bias(matmul(y, lp.wq), lp.bq).reshape(b, t, n_heads, hd)
+        k = _maybe_bias(matmul(y, lp.wk), lp.bk).reshape(b, t, n_kv, hd)
+        v = _maybe_bias(matmul(y, lp.wv), lp.bv).reshape(b, t, n_kv, hd)
         q = apply_rope(q, rope_cos, rope_sin, positions)
         k = apply_rope(k, rope_cos, rope_sin, positions)
 

@@ -60,7 +60,15 @@ _TENSOR_NAME_MAP = {
     "block_matmul_w3": "w3",
     "block_rms_norm_0": "rms_att",
     "block_rms_norm_1": "rms_ffn",
+    # Qwen2-family projection biases (header.qkv_bias; absent otherwise)
+    "block_bias_q": "bq",
+    "block_bias_k": "bk",
+    "block_bias_v": "bv",
 }
+
+# per-layer [n]-vector tensors (everything else under _TENSOR_NAME_MAP is a
+# [d_out, d_in] matmul weight)
+_VECTOR_KEYS = {"rms_att", "rms_ffn", "bq", "bk", "bv"}
 
 
 def read_m_tensors(path: str, header: ModelHeader) -> dict:
@@ -92,7 +100,10 @@ def read_m_tensors(path: str, header: ModelHeader) -> dict:
             if spec.expert >= 0:
                 w[key][spec.layer][spec.expert] = x
             else:
-                w[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
+                w[key][spec.layer] = x.reshape(-1) if key in _VECTOR_KEYS else x
+    if not header.qkv_bias:
+        for key in ("bq", "bk", "bv"):
+            del w[key]
     if E > 0:
         for key in ("w1", "w2", "w3"):
             w[key] = [np.stack(mats) for mats in w[key]]  # [E, d_out, d_in] per layer
@@ -144,8 +155,10 @@ def load_params_from_m(
     wcls = raw_w["wcls"].T  # -> [dim, vocab]
     stacked = {}
     for key in _TENSOR_NAME_MAP.values():
+        if key not in raw_w:
+            continue  # bias keys absent on bias-free models
         mats = raw_w[key]
-        if key.startswith("rms"):
+        if key in _VECTOR_KEYS:
             stacked[key] = np.stack(mats)
         else:
             # -> [L, d_in, d_out] (MoE ffn: [L, E, d_in, d_out])
@@ -172,6 +185,11 @@ def load_params_from_m(
         w3=put("w3", cast(stacked["w3"])).astype(dtype),
         rms_att=put("rms_att", stacked["rms_att"]).astype(jnp.float32),
         rms_ffn=put("rms_ffn", stacked["rms_ffn"]).astype(jnp.float32),
+        **{
+            k: put(k, stacked[k]).astype(jnp.float32)
+            for k in ("bq", "bk", "bv")
+            if k in stacked
+        },
     )
     params = LlamaParams(
         embedding=put("embedding", cast(embedding)).astype(dtype),
@@ -240,7 +258,7 @@ def load_params_from_m_quantized(
                 if spec.expert >= 0:
                     dense[key][spec.layer][spec.expert] = x
                 else:
-                    dense[key][spec.layer] = x.reshape(-1) if key.startswith("rms") else x
+                    dense[key][spec.layer] = x.reshape(-1) if key in _VECTOR_KEYS else x
 
     cast = _cast_fn(dtype)
 
@@ -289,6 +307,11 @@ def load_params_from_m_quantized(
         rms_att=put("rms_att", np.stack(dense["rms_att"])).astype(jnp.float32),
         rms_ffn=put("rms_ffn", np.stack(dense["rms_ffn"])).astype(jnp.float32),
         moe_gate=moe_gate,
+        **{
+            k: put(k, np.stack(dense[k])).astype(jnp.float32)
+            for k in ("bq", "bk", "bv")
+            if k in dense
+        },
     )
     wcls_entry = dense["wcls"]
     if wcls_entry[0] == "q40":

@@ -88,9 +88,12 @@ class OracleLlama:
         for l in range(c.n_layers):
             y = self._rms(x, self.w["rms_att"][l])
             yq = qdq(y)
-            q = (self.w["wq"][l] @ yq).reshape(c.n_heads, hd)
-            k = (self.w["wk"][l] @ yq).reshape(n_kv, hd)
-            v = (self.w["wv"][l] @ yq).reshape(n_kv, hd)
+            bq = self.w["bq"][l] if "bq" in self.w else 0.0
+            bk = self.w["bk"][l] if "bk" in self.w else 0.0
+            bv = self.w["bv"][l] if "bv" in self.w else 0.0
+            q = (self.w["wq"][l] @ yq + bq).reshape(c.n_heads, hd)
+            k = (self.w["wk"][l] @ yq + bk).reshape(n_kv, hd)
+            v = (self.w["wv"][l] @ yq + bv).reshape(n_kv, hd)
             q = self._rope(q, pos)
             k = self._rope(k, pos)
             self.k_cache[l, pos] = k

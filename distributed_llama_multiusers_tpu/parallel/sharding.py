@@ -78,6 +78,13 @@ def param_shardings(mesh: Mesh, params: LlamaParams | None = None) -> LlamaParam
         rms_att=ns("pp", None),
         rms_ffn=ns("pp", None),
         moe_gate=ns("pp", None, None) if moe else None,
+        # Qwen2 q/k/v biases: [L, d_out] vectors added to row-sliced matmul
+        # outputs, so they shard along the same tp axis as the outputs
+        **(
+            {k: ns("pp", "tp") for k in ("bq", "bk", "bv")}
+            if params is not None and lp.bq is not None
+            else {}
+        ),
     )
     return LlamaParams(
         # embedding replicated: the reference keeps it root-only

@@ -18,6 +18,9 @@ class TemplateType(IntEnum):
     LLAMA2 = 1
     LLAMA3 = 2
     DEEP_SEEK3 = 3
+    # framework extension beyond the reference's three renderers
+    # (src/tokenizer.cpp:538-559): ChatML, the Qwen2-family turn format
+    CHATML = 4
 
 
 @dataclass
@@ -39,6 +42,7 @@ def template_type_from_name(name: str | None) -> TemplateType:
         "llama2": TemplateType.LLAMA2,
         "llama3": TemplateType.LLAMA3,
         "deepSeek3": TemplateType.DEEP_SEEK3,
+        "chatml": TemplateType.CHATML,
     }[name]
 
 
@@ -77,6 +81,8 @@ class ChatTemplateGenerator:
                 template_type = TemplateType.LLAMA3
             elif "<｜Assistant｜>" in chat_template:
                 template_type = TemplateType.DEEP_SEEK3
+            elif "<|im_start|>" in chat_template:
+                template_type = TemplateType.CHATML
             else:
                 raise ValueError("Not supported chat template")
         self.type = template_type
@@ -105,6 +111,23 @@ class ChatTemplateGenerator:
                 )
             if append_generation_prompt:
                 buf.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        elif self.type == TemplateType.CHATML:
+            # <|im_start|>role\ncontent<|im_end|>\n per turn; the terminator
+            # comes from the tokenizer's EOS piece (<|im_end|> for Qwen2).
+            # Qwen's own template prepends a default system turn when the
+            # conversation does not open with one — mirror that with the
+            # Qwen2 default ("You are a helpful assistant."; Qwen2.5 ships a
+            # longer brand-specific default — pass an explicit system
+            # message to match it exactly).
+            if not items or items[0].role != "system":
+                buf.append(
+                    "<|im_start|>system\nYou are a helpful assistant."
+                    + eos + "\n"
+                )
+            for item in items:
+                buf.append("<|im_start|>" + item.role + "\n" + item.message + eos + "\n")
+            if append_generation_prompt:
+                buf.append("<|im_start|>assistant\n")
         elif self.type == TemplateType.DEEP_SEEK3:
             i = 0
             if items and items[0].role == "system":

@@ -1,0 +1,156 @@
+"""Qwen2-family support: q/k/v projection biases (KEY_QKV_BIAS) + ChatML.
+
+The reference runtime executes only the bias-free Llama graph
+(src/llm.cpp:21-24); Qwen2 support is a framework extension: the same graph
+plus per-layer q/k/v biases carried in the .m file (bias tensors follow
+their matmul tensors, formats/model_file.py model_tensor_specs) and the
+ChatML turn template (tokenizer/chat.py).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llama_multiusers_tpu.formats import load_model_header
+from distributed_llama_multiusers_tpu.formats.model_file import model_tensor_specs
+from distributed_llama_multiusers_tpu.formats.synthetic import (
+    tiny_header,
+    write_synthetic_model,
+)
+from distributed_llama_multiusers_tpu.models import (
+    init_kv_cache,
+    llama_forward,
+    load_params_from_m,
+)
+from distributed_llama_multiusers_tpu.models.loader import (
+    load_params_from_m_quantized,
+)
+from distributed_llama_multiusers_tpu.models.oracle import (
+    OracleLlama,
+    oracle_weights_from_m,
+)
+from distributed_llama_multiusers_tpu.tokenizer.chat import (
+    ChatItem,
+    ChatTemplateGenerator,
+    TemplateType,
+)
+
+from test_model_parity import jax_greedy
+
+
+@pytest.fixture(scope="module")
+def qwen_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("qwen2")
+    header = tiny_header(
+        dim=64, hidden_dim=160, n_layers=2, n_heads=4, n_kv_heads=2,
+        vocab_size=96, seq_len=48, qkv_bias=1,
+    )
+    path = str(d / "qwen.m")
+    write_synthetic_model(path, header, seed=11)
+    return path
+
+
+def test_header_carries_qkv_bias(qwen_model):
+    h = load_model_header(qwen_model)
+    assert h.qkv_bias == 1
+    names = [s.name for s in model_tensor_specs(h)]
+    assert names.index("block_bias_q") == names.index("block_matmul_q") + 1
+    assert names.index("block_bias_k") == names.index("block_matmul_k") + 1
+    assert names.index("block_bias_v") == names.index("block_matmul_v") + 1
+    # the walk must consume the file exactly (src/llm.cpp:477-479 semantics)
+    last = model_tensor_specs(h)[-1]
+    assert last.offset + last.n_bytes == h.file_size
+
+
+def test_biasfree_header_unchanged(tiny_model):
+    """Bias-free files never see the new key: header parse yields 0 and the
+    walk has no bias tensors (old files stay byte-identical)."""
+    h = load_model_header(tiny_model["model"])
+    assert h.qkv_bias == 0
+    assert not [s for s in model_tensor_specs(h) if s.name.startswith("block_bias")]
+
+
+def test_greedy_parity_vs_oracle(qwen_model):
+    """BASELINE.md's token-identity bar, with biases in the graph."""
+    h = load_model_header(qwen_model)
+    config, params = load_params_from_m(qwen_model, h, dtype=jnp.float32)
+    assert config.qkv_bias == 1
+    assert params.layers.bq is not None and params.layers.bq.shape == (2, 64)
+    assert params.layers.bk.shape == (2, 32)
+    oracle = OracleLlama(config, oracle_weights_from_m(qwen_model, h), emulate_q80=True)
+    prompt = [1, 17, 42, 9]
+    assert jax_greedy(config, params, prompt, 16) == oracle.generate_greedy(prompt, 16)
+
+
+def test_bias_changes_the_output(qwen_model):
+    """Guard against the graph silently dropping the bias leaves."""
+    h = load_model_header(qwen_model)
+    config, params = load_params_from_m(qwen_model, h, dtype=jnp.float32)
+    zeroed = params._replace(
+        layers=params.layers._replace(
+            bq=jnp.zeros_like(params.layers.bq),
+            bk=jnp.zeros_like(params.layers.bk),
+            bv=jnp.zeros_like(params.layers.bv),
+        )
+    )
+    tok = jnp.array([[5]], jnp.int32)
+    pos = jnp.array([[0]], jnp.int32)
+    with_b, _ = llama_forward(config, params, tok, pos, init_kv_cache(config, 1))
+    without_b, _ = llama_forward(config, zeroed, tok, pos, init_kv_cache(config, 1))
+    assert np.abs(np.asarray(with_b) - np.asarray(without_b)).max() > 1e-4
+
+
+def test_quantized_loader_parity(qwen_model):
+    """PackedQ40-resident load keeps the bias leaves; stream matches the
+    dense f32 load (same dequant numerics: Q40 is exact through f32)."""
+    h = load_model_header(qwen_model)
+    config_d, params_d = load_params_from_m(qwen_model, h, dtype=jnp.float32)
+    config_q, params_q = load_params_from_m_quantized(qwen_model, h, dtype=jnp.float32)
+    assert params_q.layers.bq is not None
+    prompt = [3, 8, 21]
+    assert jax_greedy(config_d, params_d, prompt, 12) == jax_greedy(
+        config_q, params_q, prompt, 12
+    )
+
+
+def test_sharded_forward_with_bias(qwen_model):
+    """TP-sharded placement: bias vectors shard along the same tp axis as
+    their matmul outputs; the sharded stream matches the unsharded one."""
+    from distributed_llama_multiusers_tpu.parallel import MeshPlan, make_mesh
+    from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+
+    h = load_model_header(qwen_model)
+    config, params = load_params_from_m(qwen_model, h, dtype=jnp.float32)
+    mesh = make_mesh(MeshPlan(tp=2))
+    sharded = shard_params(params, mesh)
+    assert sharded.layers.bq.sharding.spec[-1] == "tp"
+    prompt = [1, 17, 42]
+    ref = jax_greedy(config, params, prompt, 8)
+    got = jax_greedy(config, sharded, prompt, 8)
+    assert got == ref
+
+
+def test_chatml_template():
+    gen = ChatTemplateGenerator(
+        TemplateType.UNKNOWN,
+        "{% for m in messages %}<|im_start|>{{ m.role }}...{% endfor %}",
+        "<|im_end|>",
+    )
+    assert gen.type == TemplateType.CHATML
+    chat = gen.generate(
+        [ChatItem("system", "be brief"), ChatItem("user", "hi")],
+        append_generation_prompt=True,
+    )
+    assert chat.content == (
+        "<|im_start|>system\nbe brief<|im_end|>\n"
+        "<|im_start|>user\nhi<|im_end|>\n"
+        "<|im_start|>assistant\n"
+    )
+    # Qwen semantics: a conversation without a system turn gets the
+    # family's default system prompt prepended
+    chat = gen.generate([ChatItem("user", "hi")], append_generation_prompt=False)
+    assert chat.content == (
+        "<|im_start|>system\nYou are a helpful assistant.<|im_end|>\n"
+        "<|im_start|>user\nhi<|im_end|>\n"
+    )
