@@ -131,6 +131,29 @@ def test_sharded_forward_with_bias(qwen_model):
     assert got == ref
 
 
+def test_training_updates_biases(qwen_model):
+    """The training twin carries the bias leaves: one optimizer step moves
+    bq/bk/bv (gradients flow through the biased projections)."""
+    import optax
+
+    from distributed_llama_multiusers_tpu.training import Trainer
+
+    h = load_model_header(qwen_model)
+    config, params = load_params_from_m(qwen_model, h, dtype=jnp.float32)
+    t = Trainer(config, params, optax.adamw(1e-2))
+    before = {
+        k: np.asarray(getattr(t.params.layers, k)).copy()
+        for k in ("bq", "bk", "bv")
+    }
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, config.vocab_size, size=(2, 16)).astype(np.int32)
+    loss = t.step(tokens)
+    assert np.isfinite(loss)
+    for k, b in before.items():
+        after = np.asarray(getattr(t.params.layers, k))
+        assert np.abs(after - b).max() > 0, k
+
+
 def test_chatml_template():
     gen = ChatTemplateGenerator(
         TemplateType.UNKNOWN,
