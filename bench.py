@@ -126,13 +126,7 @@ def _random_packed_params(config, seed: int = 0, dtype=None):
 
     w = {k: packed(*s[:2], s[2]) for k, s in _weight_specs(config).items()}
     layers = LlamaLayerParams(
-        wq=w["wq"],
-        wk=w["wk"],
-        wv=w["wv"],
-        wo=w["wo"],
-        w1=w["w1"],
-        w2=w["w2"],
-        w3=w["w3"],
+        **w,
         rms_att=np.ones((L, d), np.float32),
         rms_ffn=np.ones((L, d), np.float32),
         moe_gate=(rng.standard_normal((L, d, config.n_experts), dtype=np.float32)

@@ -66,9 +66,10 @@ _TENSOR_NAME_MAP = {
     "block_bias_v": "bv",
 }
 
+_BIAS_KEYS = ("bq", "bk", "bv")
 # per-layer [n]-vector tensors (everything else under _TENSOR_NAME_MAP is a
 # [d_out, d_in] matmul weight)
-_VECTOR_KEYS = {"rms_att", "rms_ffn", "bq", "bk", "bv"}
+_VECTOR_KEYS = {"rms_att", "rms_ffn", *_BIAS_KEYS}
 
 
 def read_m_tensors(path: str, header: ModelHeader) -> dict:
@@ -102,7 +103,7 @@ def read_m_tensors(path: str, header: ModelHeader) -> dict:
             else:
                 w[key][spec.layer] = x.reshape(-1) if key in _VECTOR_KEYS else x
     if not header.qkv_bias:
-        for key in ("bq", "bk", "bv"):
+        for key in _BIAS_KEYS:
             del w[key]
     if E > 0:
         for key in ("w1", "w2", "w3"):
@@ -187,7 +188,7 @@ def load_params_from_m(
         rms_ffn=put("rms_ffn", stacked["rms_ffn"]).astype(jnp.float32),
         **{
             k: put(k, stacked[k]).astype(jnp.float32)
-            for k in ("bq", "bk", "bv")
+            for k in _BIAS_KEYS
             if k in stacked
         },
     )
@@ -309,7 +310,7 @@ def load_params_from_m_quantized(
         moe_gate=moe_gate,
         **{
             k: put(k, np.stack(dense[k])).astype(jnp.float32)
-            for k in ("bq", "bk", "bv")
+            for k in _BIAS_KEYS
             if k in dense
         },
     )
