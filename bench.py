@@ -664,10 +664,11 @@ def _phase_longctx(config, small):
 def _phase_parity(config, platform):
     """BASELINE.md's token-identity gate, measured with the SHIPPING TPU
     dtype: greedy-decode 256 tokens with the default bf16-dot kernel and
-    with exact f32 (set_pallas_w_dtype), same synthetic Q40 weights, and
-    report whether the streams are token-identical — plus the first
-    divergence step if not. Random weights have near-zero logit margins,
-    so a divergence here is the worst case, not the real-model rate; the
+    with the exact-f32 XLA dequant path (set_pallas_enabled(False); both
+    streams on f32 activations), same synthetic Q40 weights, and report
+    whether the streams are token-identical — plus the first divergence
+    step if not. Random weights have near-zero logit margins, so a
+    divergence here is the worst case, not the real-model rate; the
     interpret-mode CI test (tests/test_pallas_q40.py) pins model-scale
     identity."""
     if platform != "tpu":
@@ -680,19 +681,28 @@ def _phase_parity(config, platform):
     from distributed_llama_multiusers_tpu.runtime import InferenceEngine
     from distributed_llama_multiusers_tpu.utils.testing import greedy_rollout
 
-    params = _resident_packed_params(config)
+    # f32 embedding -> f32 activations in BOTH streams: the comparison then
+    # isolates exactly the shipping kernel's bf16 dot (which casts x down
+    # internally) against full-f32 math, instead of confounding it with
+    # bf16 activations everywhere else
+    params = _device_packed_params(config, seed=0, dtype=jnp.float32)
     prompt = list(range(1, 17))
     n = 256
     streams = {}
-    for name, wd in (("bf16", jnp.bfloat16), ("f32", jnp.float32)):
-        linear.set_pallas_w_dtype(wd)
+    # exact-f32 oracle = the XLA dequant path (unpack + f32 matmul), NOT
+    # set_pallas_w_dtype(f32): the multi-pass f32 Pallas compile blew the
+    # phase budget on hardware (round 5: >300 s, and the timeout kill wedged
+    # the tunnel). The XLA path is the same math at ordinary compile cost
+    # and is independently pinned against the numpy oracle in CI.
+    for name, enabled in (("bf16", True), ("f32", False)):
+        linear.set_pallas_enabled(enabled)
         try:
             engine = InferenceEngine(
                 config, params, n_lanes=1, prefill_buckets=(16,)
             )
             toks, _ = greedy_rollout(engine, prompt, n)
         finally:
-            linear.set_pallas_w_dtype(None)
+            linear.set_pallas_enabled(True)
         streams[name] = toks
         del engine
     mism = [i for i, (a, b) in enumerate(zip(streams["bf16"], streams["f32"]))
@@ -706,6 +716,15 @@ def _phase_parity(config, platform):
 
 
 def child_main() -> None:
+    # the parent's timeout sends SIGTERM; without a handler the default
+    # disposition kills the process as abruptly as SIGKILL (no finally
+    # blocks, no PJRT teardown) and the graceful-shutdown grace period in
+    # _run_child buys nothing. SystemExit unwinds the stack so the axon
+    # tunnel connection closes cleanly instead of dying mid-RPC.
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
     # CPU runs must strip the TPU PJRT plugin BEFORE backend discovery: this
     # box's sitecustomize registers one whose init dials a network tunnel,
     # and it blocks discovery even under JAX_PLATFORMS=cpu (see
@@ -782,27 +801,43 @@ def _run_child(env_extra: dict, timeout_s: float):
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     env.update(env_extra)
+    # Popen + SIGTERM-then-SIGKILL, NOT subprocess.run(timeout=...): run()
+    # SIGKILLs on timeout, and a child killed mid-TPU-RPC is the prime
+    # suspect for the recurring axon-tunnel wedge (round 5: the tunnel died
+    # at the parity child's timeout kill and every later phase NO_BACKENDed).
+    # A TERMed child unwinds the Python/PJRT stack and closes the tunnel
+    # connection cleanly; 20 s grace before the hard kill.
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    timed_out = False
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-        )
-    except subprocess.TimeoutExpired as e:
-        parsed = _last_json_line(_text(e.stdout))
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.terminate()
+        try:
+            stdout, stderr = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+    if timed_out:
+        parsed = _last_json_line(_text(stdout))
         if parsed is not None:
             return parsed, None
-        err = f"timeout after {timeout_s:.0f}s; stderr tail: {_text(e.stderr)[-300:]}"
-        if "[bench] backend up" not in _text(e.stderr):
+        err = f"timeout after {timeout_s:.0f}s; stderr tail: {_text(stderr)[-300:]}"
+        if "[bench] backend up" not in _text(stderr):
             # the device tunnel never initialized: retrying burns the whole
             # deadline on another hang — callers should fall back instead
             err = "NO_BACKEND " + err
         return None, err
-    parsed = _last_json_line(proc.stdout)
+    parsed = _last_json_line(_text(stdout))
     if parsed is not None:
         if proc.returncode != 0:
             parsed.setdefault("phase_rc", proc.returncode)
         return parsed, None
-    return None, f"rc={proc.returncode}; stderr tail: {_text(proc.stderr)[-400:]}"
+    return None, f"rc={proc.returncode}; stderr tail: {_text(stderr)[-400:]}"
 
 
 def main() -> None:
@@ -866,10 +901,13 @@ def main() -> None:
         {"BENCH_FORCE_CPU": "1", "GRAFT_SMALL": "1"} if force_cpu else {}
     )
     # priority order under a shared deadline = the round-4 verdict's:
-    # serving numbers, the 8B north star, the bf16 parity gate, then the
-    # ablation diagnostics (the sweep below runs with whatever is left)
+    # serving numbers, the 8B north star, then the ablation diagnostics.
+    # parity runs LAST (after the sweep): it is the phase most likely to
+    # blow its budget (two fresh engine compiles + 512 host-stepped
+    # decodes), and a timeout kill mid-TPU-RPC has wedged the tunnel for
+    # every phase after it (round 5) — order so a wedge costs nothing.
     for phase, cap in (
-        ("serving", 420.0), ("8b", 500.0), ("parity", 300.0),
+        ("serving", 420.0), ("8b", 500.0),
         ("ablations", 420.0), ("longctx", 300.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)
@@ -896,6 +934,7 @@ def main() -> None:
             SWEEP_COMBOS,
         )
 
+        tunnel_dead = False
         sweep: dict = {}
         non_default = [
             (n, s, b) for n, (s, b) in SWEEP_COMBOS.items()
@@ -936,9 +975,25 @@ def main() -> None:
             else:
                 errors.append(f"sweep[{name}]: {err}")
                 if err and err.startswith("NO_BACKEND"):
+                    tunnel_dead = True
                     break  # tunnel died mid-sweep: stop burning budget
         if sweep:
             bank({"kernel_sweep": sweep})
+
+        # parity last — see the phase-order comment above
+        budget = min(300.0, deadline - time.monotonic() - 10)
+        if tunnel_dead:
+            errors.append("parity: skipped (tunnel died mid-sweep)")
+        elif budget >= 90:
+            result, err = _run_child({"BENCH_PHASE": "parity"}, budget)
+            if result is not None:
+                bank(result)
+            else:
+                errors.append(f"parity: {err}")
+        else:
+            errors.append("parity: skipped (out of budget)")
+    else:
+        errors.append("parity: skipped (off-TPU)")
 
     # matched-model headline ratio: once the 8B north star lands on TPU,
     # compare it (not the 1B primary) against the reference's published 7B

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-5 second-window supervisor: when the TPU tunnel answers, spend the
+# window in strict value order for the kernel investigation:
+#   1. kernel_lab3  — the cheaper-dequant variant A/B (decides the rework)
+#   2. stage_probe  — micro-stage cost breakdown (dma/unpack/convert/scale)
+#   3. missing bench phases (ablations, longctx) as standalone children
+# Each step has its own timeout; steps run even if earlier ones fail. Logs
+# under scripts/hw_window_<ts>/. Never touches git.
+set -u
+DIR="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(dirname "$DIR")"
+cd "$REPO"
+DEADLINE=$(( $(date +%s) + ${WINDOW_MAX_S:-36000} ))
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  TPU_PROBE_TIMEOUT_S=120 TPU_PROBE_INTERVAL_S=180 bash scripts/tpu_watch.sh || exit 1
+  TS=$(date +%Y%m%d_%H%M%S)
+  OUT="$DIR/hw_window_$TS"
+  mkdir -p "$OUT"
+  echo "tunnel alive at $(date -u)" > "$OUT/status"
+
+  timeout 600 python scripts/kernel_lab3.py 4096 14336 8 8 \
+    > "$OUT/kernel_lab3.log" 2>&1
+  echo "kernel_lab3 rc=$?" >> "$OUT/status"
+
+  timeout 480 python scripts/stage_probe.py 4096 14336 8 8 \
+    > "$OUT/stage_probe.log" 2>&1
+  echo "stage_probe rc=$?" >> "$OUT/status"
+
+  BENCH_CHILD=1 BENCH_PHASE=ablations timeout 480 python bench.py \
+    > "$OUT/ablations.json" 2> "$OUT/ablations.err"
+  echo "ablations rc=$?" >> "$OUT/status"
+
+  BENCH_CHILD=1 BENCH_PHASE=longctx timeout 360 python bench.py \
+    > "$OUT/longctx.json" 2> "$OUT/longctx.err"
+  echo "longctx rc=$?" >> "$OUT/status"
+
+  echo DONE >> "$OUT/status"
+  # got a full window's evidence: stop so the foreground session decides
+  # what the NEXT window should run (kernel rework A/B, full re-bench)
+  exit 0
+done
+echo "next_window: deadline passed"
