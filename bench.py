@@ -503,25 +503,17 @@ def _phase_serving(config, small):
     )
 
     step_times: list[float] = []
-    real_decode = engine.decode
 
-    def timed_decode(*a, **k):
-        t0 = time.perf_counter()
-        out = real_decode(*a, **k)
-        step_times.append(time.perf_counter() - t0)
-        return out
+    def _timed(fn):
+        def wrapper(*a, **k):
+            t0 = time.perf_counter()
+            out = fn(*a, **k)
+            step_times.append(time.perf_counter() - t0)
+            return out
+        return wrapper
 
-    engine.decode = timed_decode
-
-    real_spec = engine.decode_spec
-
-    def timed_spec(*a, **k):
-        t0 = time.perf_counter()
-        out = real_spec(*a, **k)
-        step_times.append(time.perf_counter() - t0)
-        return out
-
-    engine.decode_spec = timed_spec
+    for name in ("decode", "decode_spec", "decode_multi"):
+        setattr(engine, name, _timed(getattr(engine, name)))
 
     tokenizer = _BenchTokenizer(config.vocab_size)
     sched = ContinuousBatchingScheduler(engine, tokenizer)
@@ -574,6 +566,10 @@ def _phase_serving(config, small):
         # via prefix caching — the measured serving number includes it
         "prefix_hits": stats.prefix_hits,
         "prefix_tokens_saved": stats.prefix_tokens_saved,
+        # multi-step horizons taken during the measured batch (each = up to
+        # 8 decode steps in one dispatch; step_ms percentiles count a whole
+        # horizon as one step, so read them alongside this)
+        "multi_dispatches": stats.multi_dispatches,
     }
 
 
@@ -822,6 +818,16 @@ def _run_child(env_extra: dict, timeout_s: float):
         except subprocess.TimeoutExpired:
             proc.kill()
             stdout, stderr = proc.communicate()
+    except BaseException:
+        # subprocess.run killed the child on ANY exception; keep that
+        # guarantee (e.g. KeyboardInterrupt mid-communicate) — an orphaned
+        # child would keep holding the TPU tunnel
+        proc.terminate()
+        try:
+            proc.communicate(timeout=20)
+        except Exception:
+            proc.kill()
+        raise
     if timed_out:
         parsed = _last_json_line(_text(stdout))
         if parsed is not None:
@@ -931,29 +937,31 @@ def main() -> None:
     if merged.get("platform") == "tpu":
         from distributed_llama_multiusers_tpu.ops.pallas_q40 import (
             DEFAULT_COMBO,
+            DEQUANT_MODES,
             SWEEP_COMBOS,
         )
 
         tunnel_dead = False
         sweep: dict = {}
-        non_default = [
-            (n, s, b) for n, (s, b) in SWEEP_COMBOS.items()
-            if n != DEFAULT_COMBO
+        # dequant-arithmetic variants FIRST (the round-5 hypothesis: the
+        # kernel is VPU-bound on the dequant chain, so arithmetic beats DMA
+        # geometry as the lever), then the DMA geometry combos
+        candidates = [
+            (f"dequant_{m}", {"DLLAMA_DEQUANT": m})
+            for m in DEQUANT_MODES if m != "v4"
+        ] + [
+            (n, {"DLLAMA_SINGLE_SLAB": str(s), "DLLAMA_TARGET_BLOCK": str(b)})
+            for n, (s, b) in SWEEP_COMBOS.items() if n != DEFAULT_COMBO
         ]
-        combos = non_default[:4]
-        for n, _, _ in non_default[4:]:  # no silent caps
+        combos = candidates[:6]
+        for n, _ in candidates[6:]:  # no silent caps
             errors.append(f"sweep[{n}]: skipped (combo cap)")
-        for name, slab, blk in combos:
+        for name, env in combos:
             budget = min(300.0, deadline - time.monotonic() - 10)
             if budget < 90:
                 errors.append("sweep: skipped (out of budget)")
                 break
-            result, err = _run_child(
-                {"BENCH_PHASE": "primary",
-                 "DLLAMA_SINGLE_SLAB": str(slab),
-                 "DLLAMA_TARGET_BLOCK": str(blk)},
-                budget,
-            )
+            result, err = _run_child({"BENCH_PHASE": "primary", **env}, budget)
             if result is not None and result.get("value"):
                 sweep[name] = {
                     k: result.get(k)

@@ -84,6 +84,12 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "request shares at least this many leading prompt "
                         "tokens (prefix caching); 0 disables; default: "
                         "scheduler default (16)")
+    p.add_argument("--multi-step", type=int, default=None,
+                   help="serving: chain up to this many decode steps per "
+                        "device dispatch in steady-state decode (identical "
+                        "token streams, 1/h the per-token dispatch "
+                        "overhead); 0 disables; default: scheduler "
+                        "default (8)")
     # train mode (beyond parity — no reference analogue)
     p.add_argument("--data", default=None,
                    help="train: UTF-8 text file tokenized into training batches")

@@ -192,16 +192,21 @@ def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
     from ..runtime.engine import warmup_engine
 
     speculative = not getattr(args, "no_spec", False)
+    # pass prefix_min_tokens/multi_step only when the CLI provided them: the
+    # scheduler defaults are the single source of truth for fallback values
+    pmt = getattr(args, "prefix_min_tokens", None)
+    ms = getattr(args, "multi_step", None)
+    overrides = {}
+    if pmt is not None:
+        overrides["prefix_min_tokens"] = pmt
+    if ms is not None:
+        overrides["multi_step"] = ms
     log("⏳", "Warming serving programs (prefill buckets, decode, spec)...")
     t0 = time.perf_counter()
-    warmup_engine(engine, spec=speculative)
-    log("⏳", f"Warmup done in {time.perf_counter() - t0:.1f}s")
-    # pass prefix_min_tokens only when the CLI provided it: the scheduler
-    # default is the single source of truth for the fallback value
-    pmt = getattr(args, "prefix_min_tokens", None)
     sched = ContinuousBatchingScheduler(
-        engine, tokenizer, speculative=speculative,
-        **({} if pmt is None else {"prefix_min_tokens": pmt}),
+        engine, tokenizer, speculative=speculative, **overrides,
     )
+    warmup_engine(engine, spec=speculative, multi_step=sched.multi_step)
+    log("⏳", f"Warmup done in {time.perf_counter() - t0:.1f}s")
     sched.start()
     return sched

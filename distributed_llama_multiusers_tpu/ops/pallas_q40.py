@@ -65,6 +65,17 @@ TARGET_BLOCK_BYTES = int(
     _os.environ.get("DLLAMA_TARGET_BLOCK", 1 << 20)
 )  # k-chunk size target (DMA/compute overlap)
 
+# Dequant arithmetic variant for the bf16 dot path (round-5 finding: the
+# kernel is VPU-bound on the per-weight dequant chain — hbm_util ~0.26 on
+# BOTH the 1B and the 8B, i.e. a per-byte cost with DMA hiding under it):
+#   v4         f32 dequant (nib->f32, f32 scale mul) then bf16 cast
+#   bf16chain  nib int->bf16 direct, one bf16 scale mul (no f32 round-trip)
+#   repeat     bf16chain + jnp.repeat scale broadcast (no reshape dance)
+# Exact-f32 dots (w_dtype=f32: parity gate, interpret tests) always use the
+# v4 f32 chain regardless of this knob.
+DEQUANT_MODE = _os.environ.get("DLLAMA_DEQUANT", "v4")
+DEQUANT_MODES = ("v4", "bf16chain", "repeat")
+
 # The one shared DMA-geometry sweep table: (single-slab ceiling, k-chunk
 # target) in bytes, keyed by a stable name. scripts/kernel_sweep.py runs
 # all of them; bench.py's in-bench sweep runs the non-default entries in
@@ -142,8 +153,17 @@ def _plan_blocks(d_in: int, d_out: int) -> tuple[int, int] | None:
     return w_tile, rows
 
 
+def set_dequant_mode(mode: str | None) -> None:
+    """Select the bf16-path dequant variant (None -> env/default). The mode
+    is a static argument of the jitted matmul, so switching retraces."""
+    global DEQUANT_MODE
+    if mode is not None and mode not in DEQUANT_MODES:
+        raise ValueError(f"unknown dequant mode {mode!r}; one of {DEQUANT_MODES}")
+    DEQUANT_MODE = mode or _os.environ.get("DLLAMA_DEQUANT", "v4")
+
+
 def _q40_slab_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
-                     out_ref, acc_ref, *, w_dtype, sub_tiles, n_k):
+                     out_ref, acc_ref, *, w_dtype, sub_tiles, n_k, mode):
     """One (m tile, d_out wide-tile, d_in chunk) step — two-dot formulation
     over a contiguous weight slab:
 
@@ -171,11 +191,28 @@ def _q40_slab_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
     for t in sub_tiles:
         p = packed_ref[:, off:off + t].astype(jnp.int32)
         s = _f16_bits_to_f32(scales_ref[:, off:off + t])  # [n_blk, t] f32
-        s3 = s[:, None, :]
-        w_lo = ((p & 0x0F).astype(jnp.float32).reshape(n_blk, 16, t) * s3)
-        w_hi = ((p >> 4).astype(jnp.float32).reshape(n_blk, 16, t) * s3)
-        w_lo = w_lo.reshape(rows, t).astype(w_dtype)
-        w_hi = w_hi.reshape(rows, t).astype(w_dtype)
+        if mode == "bf16chain":
+            # dequant stays in bf16: nibbles (0..15, exact in bf16) cast
+            # once, scales rounded to bf16 once per block (amortized /32),
+            # ONE bf16 mul per weight — drops the f32 round-trip + downcast
+            s3 = s.astype(jnp.bfloat16)[:, None, :]
+            w_lo = ((p & 0x0F).astype(jnp.bfloat16).reshape(n_blk, 16, t) * s3)
+            w_hi = ((p >> 4).astype(jnp.bfloat16).reshape(n_blk, 16, t) * s3)
+            w_lo = w_lo.reshape(rows, t)
+            w_hi = w_hi.reshape(rows, t)
+        elif mode == "repeat":
+            # bf16 chain with the scale broadcast as an explicit row repeat
+            # (each block's scale row 16x consecutive) instead of the
+            # reshape->broadcast->reshape dance — a relayout-cost A/B
+            s_rep = jnp.repeat(s.astype(jnp.bfloat16), 16, axis=0)
+            w_lo = (p & 0x0F).astype(jnp.bfloat16) * s_rep
+            w_hi = (p >> 4).astype(jnp.bfloat16) * s_rep
+        else:  # v4: f32 dequant, cast to the dot dtype at the end
+            s3 = s[:, None, :]
+            w_lo = ((p & 0x0F).astype(jnp.float32).reshape(n_blk, 16, t) * s3)
+            w_hi = ((p >> 4).astype(jnp.float32).reshape(n_blk, 16, t) * s3)
+            w_lo = w_lo.reshape(rows, t).astype(w_dtype)
+            w_hi = w_hi.reshape(rows, t).astype(w_dtype)
 
         # folded -8 offset: 8 * bsum_b @ s == sum_i x_i * 8 * s_block(i)
         corr = jax.lax.dot_general(
@@ -227,7 +264,6 @@ def _resolve_w_dtype(w_dtype, interpret: bool):
     return jnp.float32 if interpret else jnp.bfloat16
 
 
-@partial(jax.jit, static_argnames=("interpret", "w_dtype"))
 def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
                       w_dtype=None) -> jnp.ndarray:
     """y = x @ dequant(w). x: [..., d_in]; returns [..., d_out] in x.dtype.
@@ -237,7 +273,18 @@ def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
     f32 under interpret and bf16 on TPU — see ``_resolve_w_dtype``.
     Explicit f32 on TPU restores multi-pass f32 MXU semantics (slower,
     more mantissa); explicit bf16 under interpret is the ablation/test
-    knob."""
+    knob. The bf16 path's dequant arithmetic variant comes from
+    ``DEQUANT_MODE`` (env DLLAMA_DEQUANT / set_dequant_mode), resolved
+    here so switching modes retraces; exact-f32 dots always use the v4
+    f32 chain."""
+    w_dtype_r = _resolve_w_dtype(w_dtype, interpret)
+    mode = DEQUANT_MODE if w_dtype_r == jnp.bfloat16 else "v4"
+    return _q40_matmul_pallas_impl(x, w, interpret, w_dtype_r, mode)
+
+
+@partial(jax.jit, static_argnames=("interpret", "w_dtype", "mode"))
+def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
+                            mode) -> jnp.ndarray:
     if w.packed.ndim != 2:
         raise ValueError(f"expected 2D packed weight, got {w.packed.shape}")
     d_in, d_out = w.d_in, w.d_out
@@ -250,7 +297,6 @@ def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
     w_tile, rows = plan
     sub = _sub_tiles(w_tile)
     n_k = half // rows
-    w_dtype = _resolve_w_dtype(w_dtype, interpret)
 
     lead = x.shape[:-1]
     m = 1
@@ -283,7 +329,8 @@ def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
     scale_bits = jax.lax.bitcast_convert_type(w.scales, jnp.int16)
 
     out = pl.pallas_call(
-        partial(_q40_slab_kernel, w_dtype=w_dtype, sub_tiles=sub, n_k=n_k),
+        partial(_q40_slab_kernel, w_dtype=w_dtype, sub_tiles=sub, n_k=n_k,
+                mode=mode),
         grid=grid,
         in_specs=[
             pl.BlockSpec((m_tile, rows), lambda i, j, k: (i, k)),

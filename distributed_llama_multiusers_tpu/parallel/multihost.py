@@ -31,6 +31,7 @@ OP_DECODE = 2
 OP_DECODE_SPEC = 3
 OP_STATS_RESET = 4  # zero worker-side engine counters (post-warmup hygiene)
 OP_COPY_LANE = 5  # prefix caching: copy one lane's KV into another
+OP_DECODE_MULTI = 6  # h chained decode steps in one dispatch (h in header)
 
 
 def maybe_initialize_distributed(args=None) -> int:
@@ -79,6 +80,8 @@ class ControlPlane:
     DECODE_SPEC: the DECODE slots plus payload_f = draft tokens (flattened
     [n_lanes * SPEC_DRAFT]) and payload_g = per-lane draft lengths, so
     speculative verify steps replay on pods too.
+    DECODE_MULTI: the DECODE slots; the horizon h rides the start_pos
+    header field (multi-step decode replays as one packet per h steps).
     """
 
     HEADER = 4
@@ -156,6 +159,19 @@ class ControlPlane:
             np.asarray(seeds, np.uint32).view(np.int32),
             flat,
             np.asarray(draft_len, np.int32),
+        )
+
+    def send_decode_multi(
+        self, tokens, positions, temps, topps, seeds, h: int
+    ) -> None:
+        n = len(tokens)
+        # the horizon rides the start_pos header field
+        self._send(
+            OP_DECODE_MULTI, 0, n, h,
+            tokens, positions,
+            np.asarray(temps, np.float32).view(np.int32),
+            np.asarray(topps, np.float32).view(np.int32),
+            np.asarray(seeds, np.uint32).view(np.int32),
         )
 
     def send_stop(self) -> None:
@@ -264,6 +280,19 @@ class RootControlEngine:
             tokens, drafts, draft_len, positions, temps, topps, seeds
         )
 
+    def decode_multi(
+        self, tokens, positions, temps=None, topps=None, seeds=None,
+        h: int = 8,
+    ):
+        temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._plane.send_decode_multi(
+            np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
+            temps, topps, seeds, h,
+        )
+        return self._engine.decode_multi(
+            tokens, positions, temps, topps, seeds, h
+        )
+
     def measured_sync_stats(self, steps: int = 4) -> dict:
         """Disabled on pod roots: the probe's direct decode calls would not
         be broadcast to workers, so the SPMD program would deadlock waiting
@@ -331,6 +360,15 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 2, n).view(np.float32),
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
+            )
+        elif op == OP_DECODE_MULTI:
+            engine.decode_multi(
+                plane.slot(pkt, 0, n),
+                plane.slot(pkt, 1, n),
+                plane.slot(pkt, 2, n).view(np.float32),
+                plane.slot(pkt, 3, n).view(np.float32),
+                plane.slot(pkt, 4, n).view(np.uint32),
+                start_pos,  # horizon h rides the start_pos header field
             )
         elif op == OP_STATS_RESET:
             # warmup traffic must not pollute worker-side counters either

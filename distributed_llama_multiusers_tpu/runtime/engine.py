@@ -55,6 +55,8 @@ class EngineStats:
     spec_lane_steps: int = 0  # (drafted lane, spec-step) pairs
     prefix_hits: int = 0  # admissions that reused another lane's KV prefix
     prefix_tokens_saved: int = 0  # prompt tokens NOT re-prefilled
+    multi_dispatches: int = 0  # decode_multi calls (each = h decode steps,
+    # ONE host round-trip — the serving loop's per-token dispatch amortizer)
     # estimated per-step collective payload (bytes/chip), from the compiled
     # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
     sync_bytes_per_decode: int = 0
@@ -66,6 +68,7 @@ class EngineStats:
         self.prefill_tokens = self.decode_steps = self.host_bytes_in = 0
         self.spec_steps = self.spec_emitted = self.spec_lane_steps = 0
         self.prefix_hits = self.prefix_tokens_saved = 0
+        self.multi_dispatches = 0
         # sync_* stay: they describe the compiled program, not a window
         return snap
 
@@ -306,6 +309,45 @@ class InferenceEngine:
                 v=cache.v.at[:, dst].set(v_src),
             )
 
+        def _make_decode_multi(h):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _decode_multi(params, cache, tokens, positions, temps, topps,
+                              seeds):
+                """h chained decode steps in ONE device program (lax.scan):
+                greedy lanes feed argmax forward, device-sampled lanes feed
+                their fused sample (same fold_in(seed, pos) stream as h
+                single steps — the token sequences are identical). One
+                [h, n] transfer replaces h round trips; through a
+                high-latency device link (the serving loop's regime) the
+                per-token dispatch overhead drops by h. Host-side EOS/stop
+                handling is retroactive: steps past a lane's stop write
+                junk KV that the overwrite-before-readable invariant
+                (chunked prefill, spec verify) already covers."""
+                def body(carry, _):
+                    tok, pos, cache = carry
+                    logits, cache = llama_forward(
+                        cfg, params, tok[:, None], pos[:, None], cache,
+                        emulate_q80_activations=q80, mesh=sp_mesh,
+                        q80_sync=q80s,
+                    )
+                    step = logits[:, 0, :]
+                    greedy = jnp.argmax(step, axis=-1).astype(jnp.int32)
+                    sampled = self._sample_lanes(
+                        step, temps, topps, seeds, pos, greedy
+                    )
+                    nxt = jnp.where(temps == 0.0, greedy, sampled)
+                    return (nxt, pos + 1, cache), nxt
+
+                (_, _, cache), chosen = jax.lax.scan(
+                    body, (tokens, positions, cache), None, length=h
+                )
+                return replicate(chosen), cache  # chosen [h, n]
+
+            return _decode_multi
+
+        self._make_decode_multi = _make_decode_multi
+        self._decode_multi_fns: dict[int, object] = {}
+
         self._copy_lane_fn = _copy_lane
         self._decode_fn = _decode
         self._prefill_fn = _prefill
@@ -431,6 +473,59 @@ class InferenceEngine:
         self.stats.decode_s += time.perf_counter() - t0
         self.stats.decode_steps += 1
         return logits, greedy_np, sampled_np
+
+    # pod roots broadcast multi-step decodes as OP_DECODE_MULTI packets
+    supports_multi_step = True
+
+    def decode_multi(
+        self,
+        tokens: np.ndarray,
+        positions: np.ndarray,
+        temps: np.ndarray | None = None,
+        topps: np.ndarray | None = None,
+        seeds: np.ndarray | None = None,
+        h: int = 8,
+    ) -> np.ndarray:
+        """``h`` chained decode steps for all lanes in one device dispatch.
+
+        Feed rule per lane and step: greedy lanes (temp 0) continue with
+        argmax, device-sampled lanes with the fused sampler — byte-identical
+        to ``h`` successive ``decode`` calls (same fold_in(seed, pos) draw
+        per position). Host-exact-sampling lanes are NOT supported (they
+        need full logits on host every step); callers gate on that.
+
+        Returns ``chosen`` np[h, n]: the token each lane would feed at step
+        j+1. The caller consumes its current next_token plus chosen[:h-1]
+        and adopts chosen[h-1] as the new next_token, discarding everything
+        after a lane's stop condition — junk KV from discarded steps is
+        rewritten before any query can read it (the chunked-prefill
+        invariant; see _decode_multi)."""
+        n = self.n_lanes
+        if temps is None:
+            temps = np.zeros(n, np.float32)
+        if topps is None:
+            topps = np.full(n, 0.9, np.float32)
+        if seeds is None:
+            seeds = np.zeros(n, np.uint32)
+        fn = self._decode_multi_fns.get(h)
+        if fn is None:
+            fn = self._decode_multi_fns[h] = self._make_decode_multi(h)
+        t0 = time.perf_counter()
+        chosen, self.cache = fn(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topps, jnp.float32),
+            jnp.asarray(seeds, jnp.uint32),
+        )
+        chosen_np = np.asarray(chosen)  # ONE [h, n] transfer
+        self.stats.host_bytes_in += chosen_np.nbytes
+        self.stats.decode_s += time.perf_counter() - t0
+        self.stats.decode_steps += h
+        self.stats.multi_dispatches += 1
+        return chosen_np
 
     # drafts per speculative step (K = SPEC_DRAFT + 1 verified tokens)
     SPEC_DRAFT = SPEC_DRAFT
@@ -586,7 +681,7 @@ class InferenceEngine:
         lane's cache from position 0, and reads are masked to s <= pos."""
 
 
-def warmup_engine(engine, spec: bool = True) -> None:
+def warmup_engine(engine, spec: bool = True, multi_step: int = 0) -> None:
     """Compile every serving program up front (each prefill bucket, decode,
     and the speculative verify step) so the first real request doesn't pay
     XLA compiles mid-service — the analogue of the reference finishing its
@@ -609,6 +704,10 @@ def warmup_engine(engine, spec: bool = True) -> None:
             engine.decode_spec(
                 z, np.zeros((n, engine.SPEC_DRAFT), np.int32), z, z
             )
+        if multi_step > 1 and getattr(engine, "supports_multi_step", False):
+            # compile the top horizon bucket; smaller power-of-two buckets
+            # (batch endgames) compile on first use, cached persistently
+            engine.decode_multi(z, z, h=1 << (multi_step.bit_length() - 1))
     # pod roots: drop the replayed warmup traffic from worker counters too
     reset_workers = getattr(engine, "reset_worker_stats", None)
     if reset_workers is not None:

@@ -91,6 +91,11 @@ class RequestQueue:
         except queue.Empty:
             return None
 
+    def empty(self) -> bool:
+        """Advisory emptiness (racy by nature): the scheduler uses it to
+        decide whether a multi-step decode would delay an admission."""
+        return self._q.empty()
+
     def drain(self) -> list[Request]:
         out = []
         while True:
@@ -145,6 +150,7 @@ class ContinuousBatchingScheduler:
         host_sampling: bool = False,
         speculative: bool = True,
         prefix_min_tokens: int = 16,
+        multi_step: int = 8,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
@@ -159,7 +165,17 @@ class ContinuousBatchingScheduler:
         prompt shares at least that many leading tokens with the tokens
         already resident in some lane's KV cache (including finished
         lanes — their KV stays until overwritten) skips prefilling the
-        shared prefix via ``engine.copy_lane``. 0 disables."""
+        shared prefix via ``engine.copy_lane``. 0 disables.
+
+        ``multi_step``: when the batch is in steady-state decode (no prompt
+        chunks pending, no admissions queued, no drafts to verify, no
+        host-exact-sampling lane), run up to this many decode steps in ONE
+        device dispatch (``engine.decode_multi``) — token streams identical
+        to single stepping, but per-token host dispatch overhead divided by
+        the horizon (the dominant serving cost through a high-latency
+        device link). Stops/EOS are applied retroactively; a cancel or a
+        new admission takes effect at the next horizon boundary. 0 or 1
+        disables."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.queue = queue_ or RequestQueue()
@@ -167,6 +183,7 @@ class ContinuousBatchingScheduler:
         self.host_sampling = host_sampling
         self.speculative = speculative
         self.prefix_min_tokens = prefix_min_tokens
+        self.multi_step = multi_step
         self._lanes = [_Lane() for _ in range(engine.n_lanes)]
         # tokens whose KV each lane's cache currently holds at slots
         # [0, len): survives request finish (the KV physically remains),
@@ -364,6 +381,38 @@ class ContinuousBatchingScheduler:
             return False
         return True
 
+    def _multi_horizon(self, active, prefilled: bool) -> int:
+        """How many decode steps to chain in one device dispatch (0/1 =
+        plain single step). Multi-step is correct only in steady-state
+        decode: no prompt chunk was processed this iteration (no lane is
+        admitting), nothing is queued (an admission would wait out the
+        horizon), and no active lane needs host-exact sampling (it reads
+        full logits every step). The horizon is capped by the
+        longest-remaining lane and bucketed to powers of two so at most
+        log2(multi_step) programs ever compile."""
+        if self.multi_step <= 1 or prefilled:
+            return 0
+        if not getattr(self.engine, "supports_multi_step", False):
+            return 0
+        if not self.queue.empty():
+            return 0
+        if any(l.host_exact and l.request.temperature > 0 for _, l in active):
+            return 0
+        rem = 0
+        for _, lane in active:
+            req = lane.request
+            rem = max(rem, min(
+                req.max_tokens - len(req.generated_tokens),
+                self.engine.config.seq_len - lane.pos,
+            ))
+        h = min(self.multi_step, rem)
+        if h < 2:
+            return 0
+        p = 1
+        while p * 2 <= h:
+            p *= 2
+        return p
+
     def _finish(self, lane_idx: int, req: Request, reason: str = "stop") -> None:
         req.state = RequestState.DONE
         req.finish_reason = reason
@@ -456,9 +505,18 @@ class ContinuousBatchingScheduler:
                 if not draft_len.any():
                     draft_len = None  # nothing to verify: plain step
 
+            chosen = None
+            h = 0 if draft_len is not None else self._multi_horizon(
+                active, prefilled
+            )
             if draft_len is not None:
                 logits, emitted, n_emit = self.engine.decode_spec(
                     tokens, drafts, draft_len, positions, temps, topps, seeds
+                )
+            elif h > 1:
+                logits = None  # host-exact lanes are excluded by the gate
+                chosen = self.engine.decode_multi(
+                    tokens, positions, temps, topps, seeds, h
                 )
             else:
                 logits, greedy, sampled = self.engine.decode(
@@ -502,6 +560,23 @@ class ContinuousBatchingScheduler:
                         continue
                     nxt_greedy = int(emitted[i, cnt - 1])
                     nxt_sampled = int(emitted[i, 0])  # n_emit==1 for temp>0
+                elif chosen is not None:
+                    # multi-step horizon: consume next_token + the first
+                    # h-1 chained choices; the last choice becomes the new
+                    # pending token. Tokens past a stop are discarded (their
+                    # junk KV is rewritten before any query reads it).
+                    seq = [lane.next_token] + [
+                        int(chosen[j, i]) for j in range(h - 1)
+                    ]
+                    alive = True
+                    for t in seq:
+                        if not self._consume(i, lane, t):
+                            alive = False
+                            break
+                    if not alive:
+                        continue
+                    lane.next_token = int(chosen[h - 1, i])
+                    continue  # greedy/sampled feed already encoded in chosen
                 else:
                     if not self._consume(i, lane, lane.next_token):
                         continue

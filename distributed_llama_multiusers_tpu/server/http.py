@@ -114,6 +114,9 @@ class ApiServer:
                 if stats.spec_lane_steps else None
             ),
             "sync_bytes_per_decode": stats.sync_bytes_per_decode,
+            # multi-step horizons taken (each = several decode steps in one
+            # device dispatch; decode_steps counts the chained steps)
+            "multi_dispatches": stats.multi_dispatches,
             "prefix_hits": stats.prefix_hits,
             "prefix_tokens_saved": stats.prefix_tokens_saved,
             "lanes_total": total,

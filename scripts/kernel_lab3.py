@@ -277,13 +277,17 @@ def check():
     xf = jnp.asarray(rng.standard_normal((M, d_in), np.float32))
     w_ref = _ref_dequant(packed, np.asarray(scales, np.float32).astype(np.float16))
     y_ref = np.asarray(xf) @ w_ref
+    failed = False
     for name in KERNELS:
         y = np.asarray(
             _call_kernel(name, xf, packed, sb, d_in, d_out, chunk, tile)
         )
         rel = np.abs(y - y_ref).max() / (np.abs(y_ref).max() + 1e-9)
-        status = "ok" if rel < 2e-2 else "FAIL"
-        print(f"{name:16s} max-rel-err {rel:.2e}  {status}")
+        ok = rel < 2e-2
+        failed |= not ok
+        print(f"{name:16s} max-rel-err {rel:.2e}  {'ok' if ok else 'FAIL'}")
+    if failed:
+        sys.exit(1)
 
 
 def main():

@@ -202,6 +202,38 @@ def test_pallas_bf16_weight_tiles_close():
     assert not np.array_equal(np.asarray(loose), np.asarray(exact))
 
 
+def test_dequant_mode_variants_close():
+    """Every DEQUANT_MODE (the bf16-path arithmetic A/B: v4 f32-chain,
+    bf16chain, repeat) stays within bf16 rounding of the exact f32 kernel,
+    and the mode switch actually retraces (set_dequant_mode is a static
+    arg of the jitted matmul)."""
+    from distributed_llama_multiusers_tpu.ops.pallas_q40 import (
+        DEQUANT_MODES,
+        set_dequant_mode,
+    )
+
+    rng = np.random.default_rng(7)
+    pw = _pack(rng, 256, 128)
+    x = jnp.asarray(rng.standard_normal((4, 128), dtype=np.float32))
+    exact = np.asarray(q40_matmul_pallas(x, pw, interpret=True))
+    try:
+        for mode in DEQUANT_MODES:
+            set_dequant_mode(mode)
+            got = np.asarray(
+                q40_matmul_pallas(x, pw, interpret=True, w_dtype=jnp.bfloat16)
+            )
+            # bf16 rounding error scales with the CONTRACTION magnitude,
+            # not the output element (cancellation leaves small outputs
+            # with proportionally larger error) — bound it vs max|y|
+            rel = np.abs(got - exact).max() / (np.abs(exact).max() + 1e-9)
+            assert rel < 2e-2, f"mode {mode}: max-rel {rel:.3e}"
+            # exact-f32 dots ignore the mode knob entirely
+            f32 = np.asarray(q40_matmul_pallas(x, pw, interpret=True))
+            np.testing.assert_array_equal(f32, exact, err_msg=f"mode {mode}")
+    finally:
+        set_dequant_mode(None)
+
+
 def test_bf16_w_dtype_greedy_stream_model_scale(tiny_model):
     """End-to-end greedy stream with the SHIPPING TPU numeric default
     (w_dtype=bf16 dots, round-4 advisor finding: that path had no CI
