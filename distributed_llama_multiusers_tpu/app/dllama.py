@@ -74,6 +74,13 @@ def run_inference(args) -> None:
             args.temperature == 0.0 and not getattr(args, "no_spec", False)
         ),
         prompt_tokens=tokens,
+        # greedy runs chain plain decode steps when no draft hits (one
+        # dispatch per horizon); temp>0 samples from logits every step
+        multi_h=(
+            0 if args.temperature > 0.0
+            else (8 if getattr(args, "multi_step", None) is None
+                  else args.multi_step)
+        ),
     )
     for _ in range(args.steps):
         piece = tokenizer.decode(cur)
@@ -139,6 +146,11 @@ def run_chat(args) -> None:
         config,
         enabled=(
             args.temperature == 0.0 and not getattr(args, "no_spec", False)
+        ),
+        multi_h=(
+            0 if args.temperature > 0.0
+            else (8 if getattr(args, "multi_step", None) is None
+                  else args.multi_step)
         ),
     )
 

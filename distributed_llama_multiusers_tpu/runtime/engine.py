@@ -705,9 +705,11 @@ def warmup_engine(engine, spec: bool = True, multi_step: int = 0) -> None:
                 z, np.zeros((n, engine.SPEC_DRAFT), np.int32), z, z
             )
         if multi_step > 1 and getattr(engine, "supports_multi_step", False):
+            from .spec import pow2_floor
+
             # compile the top horizon bucket; smaller power-of-two buckets
             # (batch endgames) compile on first use, cached persistently
-            engine.decode_multi(z, z, h=1 << (multi_step.bit_length() - 1))
+            engine.decode_multi(z, z, h=pow2_floor(multi_step))
     # pod roots: drop the replayed warmup traffic from worker counters too
     reset_workers = getattr(engine, "reset_worker_stats", None)
     if reset_workers is not None:

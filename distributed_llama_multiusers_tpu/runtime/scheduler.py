@@ -405,13 +405,10 @@ class ContinuousBatchingScheduler:
                 req.max_tokens - len(req.generated_tokens),
                 self.engine.config.seq_len - lane.pos,
             ))
-        h = min(self.multi_step, rem)
-        if h < 2:
-            return 0
-        p = 1
-        while p * 2 <= h:
-            p *= 2
-        return p
+        from .spec import pow2_floor
+
+        p = pow2_floor(min(self.multi_step, rem))
+        return p if p > 1 else 0
 
     def _finish(self, lane_idx: int, req: Request, reason: str = "stop") -> None:
         req.state = RequestState.DONE

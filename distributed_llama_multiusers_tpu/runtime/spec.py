@@ -19,6 +19,13 @@ from __future__ import annotations
 SPEC_DRAFT = 3
 
 
+def pow2_floor(h: int) -> int:
+    """Largest power of two <= h (0 for h < 1). The ONE bucketing rule for
+    multi-step horizons: every dispatch site must land on these buckets so
+    warmup_engine's compiled program is the one the serving loop uses."""
+    return 1 << (h.bit_length() - 1) if h >= 1 else 0
+
+
 class NgramDraftIndex:
     """Committed token history + n-gram -> last-start-position index."""
 
@@ -63,7 +70,15 @@ class SpecStream:
     seq_len the draft length is clamped to the slots left (the cache
     scatter drops overshooting writes — models/llama.py KV append)."""
 
-    def __init__(self, engine, config, enabled: bool, prompt_tokens=()):
+    def __init__(self, engine, config, enabled: bool, prompt_tokens=(),
+                 multi_h: int = 0):
+        """``multi_h`` > 1 enables the multi-step fallback for GREEDY
+        streams: when no draft hits, chain up to that many decode steps in
+        one device dispatch (engine.decode_multi) and serve the chained
+        tokens from the same pending-lookahead buffer drafts use — one
+        host round-trip per horizon instead of per token. Temperature>0
+        callers must leave it 0 (they sample from last_logits every
+        step)."""
         import numpy as np
 
         self.engine = engine
@@ -75,7 +90,15 @@ class SpecStream:
             and getattr(engine, "supports_speculative", False)
         )
         self.drafter = NgramDraftIndex(prompt_tokens) if self.enabled else None
+        self.multi_h = (
+            multi_h
+            if multi_h > 1 and getattr(engine, "supports_multi_step", False)
+            else 0
+        )
         self.pending: list[int] = []  # produced-but-not-yet-emitted lookahead
+        # whether `pending` came from a spec verify (counts toward the
+        # speculation acceptance stats) or a multi-step horizon (must not)
+        self._pending_spec = False
         self._toks = np.zeros(engine.n_lanes, np.int32)
         self._poss = np.zeros(engine.n_lanes, np.int32)
         self.last_logits = None  # batch logits of the last real forward
@@ -98,7 +121,7 @@ class SpecStream:
             if self.drafter is not None:
                 self.drafter.append(cur)
             stats = getattr(self.engine, "stats", None)
-            if stats is not None:
+            if stats is not None and self._pending_spec:
                 stats.spec_emitted += 1  # lookahead token consumed NOW
             return self.pending.pop(0), False
         draft: list[int] = []
@@ -119,6 +142,7 @@ class SpecStream:
             )
             seq = [int(t) for t in em[0, : int(ne[0])]]
             self.pending = seq[1:]
+            self._pending_spec = True
             # consumed-only accounting, same semantics as the scheduler's
             # loop: the tokens still in `pending` count when popped (and
             # never count if a turn ends and discards them)
@@ -127,6 +151,18 @@ class SpecStream:
                 stats.spec_lane_steps += 1
                 stats.spec_emitted += 1  # seq[0], consumed now
             return seq[0], True
+        if self.multi_h > 1:
+            # no draft: chain a horizon of plain decode steps instead of
+            # one. KV alignment matches the spec path: the scan feeds
+            # cur, chosen[0..h-2] at pos..pos+h-1 (all written); the last
+            # chosen token is fed by a later advance() forward.
+            p = pow2_floor(min(self.multi_h, self.config.seq_len - pos))
+            if p > 1:
+                chosen = self.engine.decode_multi(self._toks, self._poss, h=p)
+                seq = [int(chosen[j, 0]) for j in range(p)]
+                self.pending = seq[1:]
+                self._pending_spec = False
+                return seq[0], True
         logits_b, greedy_b, _ = self.engine.decode(self._toks, self._poss)
         self.last_logits = logits_b
         return int(greedy_b[0]), True

@@ -279,3 +279,38 @@ def test_spec_stream_emits_plain_stream_with_fewer_forwards(loaded):
         f"speculation never accepted a draft ({forwards} forwards for "
         f"{len(out)} tokens on repetitive output)"
     )
+
+
+def test_spec_stream_multi_step_fallback(loaded):
+    """With multi_h set, draft-less greedy steps chain a horizon of plain
+    decodes: the emitted stream is still EXACTLY the plain greedy stream,
+    dispatches drop well below one per token, and multi-step pending
+    tokens do NOT count toward the speculation acceptance stats."""
+    from distributed_llama_multiusers_tpu.runtime.spec import SpecStream
+
+    config, params, tok = loaded
+    prompt = tok.encode("one two three four")
+    n = 24
+
+    ref_engine = _fresh_engine(config, params, n_lanes=1)
+    ref = _greedy_rollout(ref_engine, prompt, n)
+
+    engine = _fresh_engine(config, params, n_lanes=1)
+    _, g0, pos = engine.prefill(0, prompt)
+    engine.stats.reset()
+    # spec disabled (no drafter): isolates the multi-step path
+    spec = SpecStream(engine, config, enabled=False, multi_h=4)
+    cur, out, forwards = int(g0), [int(g0)], 0
+    while len(out) < n and pos < config.seq_len - 1:
+        nxt, used_forward = spec.advance(cur, pos)
+        forwards += used_forward
+        pos += 1
+        cur = nxt
+        out.append(cur)
+    assert out == ref[: len(out)]
+    assert forwards <= (len(out) + 3) // 4 + 1, (
+        f"{forwards} dispatches for {len(out)} tokens at multi_h=4"
+    )
+    assert engine.stats.multi_dispatches > 0
+    assert engine.stats.spec_emitted == 0  # multi tokens aren't "accepted"
+    assert engine.stats.spec_lane_steps == 0
