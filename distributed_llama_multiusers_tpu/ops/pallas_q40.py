@@ -71,10 +71,16 @@ TARGET_BLOCK_BYTES = int(
 #   v4         f32 dequant (nib->f32, f32 scale mul) then bf16 cast
 #   bf16chain  nib int->bf16 direct, one bf16 scale mul (no f32 round-trip)
 #   repeat     bf16chain + jnp.repeat scale broadcast (no reshape dance)
+#   blockdot   per-quant-block MXU dots on RAW bf16 nibbles; the scale (and
+#              the folded -8 offset) hit each block's [m, t] OUTPUT — the
+#              per-weight VPU chain shrinks to mask + cast (~2 ops), with
+#              the post-scale costing m/32 ops/weight (so decode-shaped m
+#              only: m > 32 falls back to bf16chain)
 # Exact-f32 dots (w_dtype=f32: parity gate, interpret tests) always use the
 # v4 f32 chain regardless of this knob.
 DEQUANT_MODE = _os.environ.get("DLLAMA_DEQUANT", "v4")
-DEQUANT_MODES = ("v4", "bf16chain", "repeat")
+DEQUANT_MODES = ("v4", "bf16chain", "repeat", "blockdot")
+BLOCKDOT_MAX_M = 32  # above this, the post-scale FMA outweighs the savings
 
 # The one shared DMA-geometry sweep table: (single-slab ceiling, k-chunk
 # target) in bytes, keyed by a stable name. scripts/kernel_sweep.py runs
@@ -243,6 +249,65 @@ def _q40_slab_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
             out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
+def _q40_blockdot_kernel(xlt_ref, xht_ref, bsum_t_ref, packed_ref, scales_ref,
+                        out_ref, acc_ref, *, sub_tiles, n_k):
+    """blockdot mode: one (m tile, d_out wide-tile, d_in chunk) step where
+    the MXU does the dequant scaling implicitly. Per quant block b, two
+    small dots contract the RAW bf16 nibbles against the matching 16-row
+    x slices (x arrives TRANSPOSED [rows, m] so the slices are sublane
+    ranges, not sub-128 lane slices); the block's scale and the folded -8
+    offset then hit the [m, t] block output once:
+
+        y += (x_lo_b @ nib_lo_b + x_hi_b @ nib_hi_b - 8*bsum_b) * s_b
+
+    Per-weight VPU work = mask + int->bf16 cast (~2 ops vs ~4.5 for the
+    f32 chain); the post-scale FMA costs m/32 ops per weight, which is why
+    callers cap m (BLOCKDOT_MAX_M). MXU pays n_blk small 16-deep dots per
+    sub-tile — idle capacity at decode shapes (mfu ~0.002)."""
+    rows, _ = packed_ref.shape
+    n_blk = rows // 16
+    k = pl.program_id(2)
+    bs = bsum_t_ref[...]  # [n_blk, m_tile] f32
+    dn = (((0,), (0,)), ((), ()))
+    off = 0
+    for t in sub_tiles:
+        p = packed_ref[:, off:off + t].astype(jnp.int32)
+        s = _f16_bits_to_f32(scales_ref[:, off:off + t])  # [n_blk, t]
+        nib_lo = (p & 0x0F).astype(jnp.bfloat16)
+        nib_hi = (p >> 4).astype(jnp.bfloat16)
+        part = None
+        for b in range(n_blk):
+            lo = jax.lax.dot_general(
+                xlt_ref[16 * b:16 * (b + 1), :].astype(jnp.bfloat16),
+                nib_lo[16 * b:16 * (b + 1), :], dn,
+                preferred_element_type=jnp.float32,
+            )
+            hi = jax.lax.dot_general(
+                xht_ref[16 * b:16 * (b + 1), :].astype(jnp.bfloat16),
+                nib_hi[16 * b:16 * (b + 1), :], dn,
+                preferred_element_type=jnp.float32,
+            )
+            contrib = (lo + hi - 8.0 * bs[b, :, None]) * s[b][None, :]
+            part = contrib if part is None else part + contrib
+
+        if n_k == 1:
+            out_ref[:, off:off + t] = part.astype(out_ref.dtype)
+        else:
+            @pl.when(k == 0)
+            def _(part=part, off=off, t=t):
+                acc_ref[:, off:off + t] = part
+
+            @pl.when(k > 0)
+            def _(part=part, off=off, t=t):
+                acc_ref[:, off:off + t] = acc_ref[:, off:off + t] + part
+        off += t
+
+    if n_k > 1:
+        @pl.when(k == n_k - 1)
+        def _():
+            out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
 def pallas_supports(w: PackedQ40) -> bool:
     """True when the slab kernel handles these shapes; otherwise callers
     take the q40_matmul_xla fallback (ops/linear.py). d_in must cover whole
@@ -276,9 +341,16 @@ def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
     knob. The bf16 path's dequant arithmetic variant comes from
     ``DEQUANT_MODE`` (env DLLAMA_DEQUANT / set_dequant_mode), resolved
     here so switching modes retraces; exact-f32 dots always use the v4
-    f32 chain."""
+    f32 chain; blockdot's post-scale FMA scales with m, so large-m calls
+    (prefill/training) fall back to bf16chain."""
     w_dtype_r = _resolve_w_dtype(w_dtype, interpret)
     mode = DEQUANT_MODE if w_dtype_r == jnp.bfloat16 else "v4"
+    if mode == "blockdot":
+        m = 1
+        for s_ in x.shape[:-1]:
+            m *= s_
+        if m > BLOCKDOT_MAX_M:
+            mode = "bf16chain"
     return _q40_matmul_pallas_impl(x, w, interpret, w_dtype_r, mode)
 
 
@@ -328,13 +400,25 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
 
     scale_bits = jax.lax.bitcast_convert_type(w.scales, jnp.int16)
 
+    if mode == "blockdot":
+        # x TRANSPOSED [rows, m]: the kernel slices 16-row (one quant
+        # block) ranges, which must land on the sublane axis — sub-128
+        # lane slices would relayout
+        xa, xb_ = x_lo.T, x_hi.T
+        x_spec = pl.BlockSpec((rows, m_tile), lambda i, j, k: (k, i))
+        kernel = partial(_q40_blockdot_kernel, sub_tiles=sub, n_k=n_k)
+    else:
+        xa, xb_ = x_lo, x_hi
+        x_spec = pl.BlockSpec((m_tile, rows), lambda i, j, k: (i, k))
+        kernel = partial(_q40_slab_kernel, w_dtype=w_dtype, sub_tiles=sub,
+                         n_k=n_k, mode=mode)
+
     out = pl.pallas_call(
-        partial(_q40_slab_kernel, w_dtype=w_dtype, sub_tiles=sub, n_k=n_k,
-                mode=mode),
+        kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((m_tile, rows), lambda i, j, k: (i, k)),
-            pl.BlockSpec((m_tile, rows), lambda i, j, k: (i, k)),
+            x_spec,
+            x_spec,
             pl.BlockSpec((rows // 16, m_tile), lambda i, j, k: (k, i)),
             pl.BlockSpec((rows, w_tile), lambda i, j, k: (k, j)),
             pl.BlockSpec((rows // 16, w_tile), lambda i, j, k: (k, j)),
@@ -354,7 +438,7 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
             transcendentals=0,
         ),
         interpret=interpret,
-    )(x_lo, x_hi, bsum_t, w.packed, scale_bits)
+    )(xa, xb_, bsum_t, w.packed, scale_bits)
 
     return out[:m].reshape(*lead, d_out)
 

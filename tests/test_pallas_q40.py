@@ -230,6 +230,37 @@ def test_dequant_mode_variants_close():
             # exact-f32 dots ignore the mode knob entirely
             f32 = np.asarray(q40_matmul_pallas(x, pw, interpret=True))
             np.testing.assert_array_equal(f32, exact, err_msg=f"mode {mode}")
+        # blockdot's post-scale cost scales with m: large-m calls
+        # (prefill/training) must RESOLVE to bf16chain (observed via the
+        # impl's mode argument — output closeness alone can't distinguish
+        # a working fallback from blockdot incorrectly running at m=64)
+        from distributed_llama_multiusers_tpu.ops import pallas_q40 as pq
+
+        seen_modes = []
+        real_impl = pq._q40_matmul_pallas_impl
+
+        def spy(x_, w_, interpret_, w_dtype_, mode_):
+            seen_modes.append(mode_)
+            return real_impl(x_, w_, interpret_, w_dtype_, mode_)
+
+        set_dequant_mode("blockdot")
+        pq._q40_matmul_pallas_impl = spy
+        try:
+            x_big = jnp.asarray(
+                rng.standard_normal((64, 128), dtype=np.float32)
+            )
+            exact_big = np.asarray(q40_matmul_pallas(x_big, pw, interpret=True))
+            seen_modes.clear()
+            got_big = np.asarray(
+                q40_matmul_pallas(
+                    x_big, pw, interpret=True, w_dtype=jnp.bfloat16
+                )
+            )
+        finally:
+            pq._q40_matmul_pallas_impl = real_impl
+        assert seen_modes == ["bf16chain"], seen_modes
+        rel = np.abs(got_big - exact_big).max() / (np.abs(exact_big).max() + 1e-9)
+        assert rel < 2e-2, f"blockdot large-m fallback: max-rel {rel:.3e}"
     finally:
         set_dequant_mode(None)
 
