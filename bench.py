@@ -1021,8 +1021,11 @@ def main() -> None:
             (f"dequant_{m}", {"DLLAMA_DEQUANT": m})
             for m in DEQUANT_MODES if m != "v4"
         ] + [
+            # geometry largest-first: the whole-plane single-DMA combo is
+            # the most distinct datapoint, the near-default ones the least
             (n, {"DLLAMA_SINGLE_SLAB": str(s), "DLLAMA_TARGET_BLOCK": str(b)})
-            for n, (s, b) in SWEEP_COMBOS.items() if n != DEFAULT_COMBO
+            for n, (s, b) in reversed(list(SWEEP_COMBOS.items()))
+            if n != DEFAULT_COMBO
         ]
         combos = candidates[:7]
         for n, _ in candidates[7:]:  # no silent caps

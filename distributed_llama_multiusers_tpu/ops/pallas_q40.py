@@ -71,6 +71,9 @@ TARGET_BLOCK_BYTES = int(
 #   v4         f32 dequant (nib->f32, f32 scale mul) then bf16 cast
 #   bf16chain  nib int->bf16 direct, one bf16 scale mul (no f32 round-trip)
 #   repeat     bf16chain + jnp.repeat scale broadcast (no reshape dance)
+#   u8chain    nibble masks on NATIVE 8-bit lanes (before any widening
+#              relayout), int8->bf16 cast, bf16 scale mul — targets the
+#              uint8->int32 expansion cost the other chains all pay
 #   blockdot   per-quant-block MXU dots on RAW bf16 nibbles; the scale (and
 #              the folded -8 offset) hit each block's [m, t] OUTPUT — the
 #              per-weight VPU chain shrinks to mask + cast (~2 ops), with
@@ -79,7 +82,7 @@ TARGET_BLOCK_BYTES = int(
 # Exact-f32 dots (w_dtype=f32: parity gate, interpret tests) always use the
 # v4 f32 chain regardless of this knob.
 DEQUANT_MODE = _os.environ.get("DLLAMA_DEQUANT", "v4")
-DEQUANT_MODES = ("v4", "bf16chain", "repeat", "blockdot")
+DEQUANT_MODES = ("v4", "bf16chain", "repeat", "u8chain", "blockdot")
 BLOCKDOT_MAX_M = 32  # above this, the post-scale FMA outweighs the savings
 
 # The one shared DMA-geometry sweep table: (single-slab ceiling, k-chunk
@@ -159,6 +162,29 @@ def _plan_blocks(d_in: int, d_out: int) -> tuple[int, int] | None:
     return w_tile, rows
 
 
+def _acc_epilogue(part, off, t, k, n_k, out_ref, acc_ref):
+    """Shared k-axis accumulation for one sub-tile's partial sum: direct
+    write when the reduction has one chunk, else init/accumulate into the
+    f32 VMEM scratch (finalized by ``_final_writeback``)."""
+    if n_k == 1:
+        out_ref[:, off:off + t] = part.astype(out_ref.dtype)
+    else:
+        @pl.when(k == 0)
+        def _(part=part, off=off, t=t):
+            acc_ref[:, off:off + t] = part
+
+        @pl.when(k > 0)
+        def _(part=part, off=off, t=t):
+            acc_ref[:, off:off + t] = acc_ref[:, off:off + t] + part
+
+
+def _final_writeback(k, n_k, out_ref, acc_ref):
+    if n_k > 1:
+        @pl.when(k == n_k - 1)
+        def _():
+            out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
 def set_dequant_mode(mode: str | None) -> None:
     """Select the bf16-path dequant variant (None -> env/default). The mode
     is a static argument of the jitted matmul, so switching retraces."""
@@ -195,12 +221,23 @@ def _q40_slab_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
 
     off = 0
     for t in sub_tiles:
-        p = packed_ref[:, off:off + t].astype(jnp.int32)
         s = _f16_bits_to_f32(scales_ref[:, off:off + t])  # [n_blk, t] f32
-        if mode == "bf16chain":
+        if mode == "u8chain":
+            # mask on native 8-bit lanes BEFORE any widening: the other
+            # chains pay a uint8->int32 expansion relayout up front
+            p8 = packed_ref[:, off:off + t]
+            s3 = s.astype(jnp.bfloat16)[:, None, :]
+            lo8 = (p8 & jnp.uint8(0x0F)).astype(jnp.int8)
+            hi8 = (p8 >> jnp.uint8(4)).astype(jnp.int8)
+            w_lo = (lo8.astype(jnp.bfloat16).reshape(n_blk, 16, t) * s3)
+            w_hi = (hi8.astype(jnp.bfloat16).reshape(n_blk, 16, t) * s3)
+            w_lo = w_lo.reshape(rows, t)
+            w_hi = w_hi.reshape(rows, t)
+        elif mode == "bf16chain":
             # dequant stays in bf16: nibbles (0..15, exact in bf16) cast
             # once, scales rounded to bf16 once per block (amortized /32),
             # ONE bf16 mul per weight — drops the f32 round-trip + downcast
+            p = packed_ref[:, off:off + t].astype(jnp.int32)
             s3 = s.astype(jnp.bfloat16)[:, None, :]
             w_lo = ((p & 0x0F).astype(jnp.bfloat16).reshape(n_blk, 16, t) * s3)
             w_hi = ((p >> 4).astype(jnp.bfloat16).reshape(n_blk, 16, t) * s3)
@@ -210,10 +247,12 @@ def _q40_slab_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
             # bf16 chain with the scale broadcast as an explicit row repeat
             # (each block's scale row 16x consecutive) instead of the
             # reshape->broadcast->reshape dance — a relayout-cost A/B
+            p = packed_ref[:, off:off + t].astype(jnp.int32)
             s_rep = jnp.repeat(s.astype(jnp.bfloat16), 16, axis=0)
             w_lo = (p & 0x0F).astype(jnp.bfloat16) * s_rep
             w_hi = (p >> 4).astype(jnp.bfloat16) * s_rep
         else:  # v4: f32 dequant, cast to the dot dtype at the end
+            p = packed_ref[:, off:off + t].astype(jnp.int32)
             s3 = s[:, None, :]
             w_lo = ((p & 0x0F).astype(jnp.float32).reshape(n_blk, 16, t) * s3)
             w_hi = ((p >> 4).astype(jnp.float32).reshape(n_blk, 16, t) * s3)
@@ -230,23 +269,9 @@ def _q40_slab_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
             + jnp.dot(x_hi, w_hi, preferred_element_type=jnp.float32)
             - 8.0 * corr
         )
-
-        if n_k == 1:
-            out_ref[:, off:off + t] = part.astype(out_ref.dtype)
-        else:
-            @pl.when(k == 0)
-            def _(part=part, off=off, t=t):
-                acc_ref[:, off:off + t] = part
-
-            @pl.when(k > 0)
-            def _(part=part, off=off, t=t):
-                acc_ref[:, off:off + t] = acc_ref[:, off:off + t] + part
+        _acc_epilogue(part, off, t, k, n_k, out_ref, acc_ref)
         off += t
-
-    if n_k > 1:
-        @pl.when(k == n_k - 1)
-        def _():
-            out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+    _final_writeback(k, n_k, out_ref, acc_ref)
 
 
 def _q40_blockdot_kernel(xlt_ref, xht_ref, bsum_t_ref, packed_ref, scales_ref,
@@ -268,6 +293,8 @@ def _q40_blockdot_kernel(xlt_ref, xht_ref, bsum_t_ref, packed_ref, scales_ref,
     n_blk = rows // 16
     k = pl.program_id(2)
     bs = bsum_t_ref[...]  # [n_blk, m_tile] f32
+    xl = xlt_ref[...].astype(jnp.bfloat16)  # cast ONCE, slice per block
+    xh = xht_ref[...].astype(jnp.bfloat16)
     dn = (((0,), (0,)), ((), ()))
     off = 0
     for t in sub_tiles:
@@ -278,34 +305,20 @@ def _q40_blockdot_kernel(xlt_ref, xht_ref, bsum_t_ref, packed_ref, scales_ref,
         part = None
         for b in range(n_blk):
             lo = jax.lax.dot_general(
-                xlt_ref[16 * b:16 * (b + 1), :].astype(jnp.bfloat16),
+                xl[16 * b:16 * (b + 1), :],
                 nib_lo[16 * b:16 * (b + 1), :], dn,
                 preferred_element_type=jnp.float32,
             )
             hi = jax.lax.dot_general(
-                xht_ref[16 * b:16 * (b + 1), :].astype(jnp.bfloat16),
+                xh[16 * b:16 * (b + 1), :],
                 nib_hi[16 * b:16 * (b + 1), :], dn,
                 preferred_element_type=jnp.float32,
             )
             contrib = (lo + hi - 8.0 * bs[b, :, None]) * s[b][None, :]
             part = contrib if part is None else part + contrib
-
-        if n_k == 1:
-            out_ref[:, off:off + t] = part.astype(out_ref.dtype)
-        else:
-            @pl.when(k == 0)
-            def _(part=part, off=off, t=t):
-                acc_ref[:, off:off + t] = part
-
-            @pl.when(k > 0)
-            def _(part=part, off=off, t=t):
-                acc_ref[:, off:off + t] = acc_ref[:, off:off + t] + part
+        _acc_epilogue(part, off, t, k, n_k, out_ref, acc_ref)
         off += t
-
-    if n_k > 1:
-        @pl.when(k == n_k - 1)
-        def _():
-            out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+    _final_writeback(k, n_k, out_ref, acc_ref)
 
 
 def pallas_supports(w: PackedQ40) -> bool:
