@@ -1021,6 +1021,15 @@ def main() -> None:
             (f"dequant_{m}", {"DLLAMA_DEQUANT": m})
             for m in DEQUANT_MODES if m != "v4"
         ] + [
+            # the round-2 kernel's narrow-tile layout (512-lane blocks,
+            # ~256 KB chunks) measured hbm_util 0.438 where the full-width
+            # slab measured 0.259 — reproduce it as a geometry candidate
+            ("r02_narrow512", {
+                "DLLAMA_W_MAX": "512",
+                "DLLAMA_SINGLE_SLAB": "262144",
+                "DLLAMA_TARGET_BLOCK": "262144",
+            }),
+        ] + [
             # geometry largest-first: the whole-plane single-DMA combo is
             # the most distinct datapoint, the near-default ones the least
             (n, {"DLLAMA_SINGLE_SLAB": str(s), "DLLAMA_TARGET_BLOCK": str(b)})

@@ -106,7 +106,13 @@ def pack_q40_host(w: np.ndarray):
 # pure-math helpers so the loader can pad without importing Pallas.
 # ---------------------------------------------------------------------------
 
-PALLAS_W_MAX = 8192  # widest output block of the slab kernel
+import os as _os
+
+# widest output block of the slab kernel. Env-overridable for hardware
+# geometry A/Bs (bench sweep "r02_narrow512": the round-2 kernel's
+# 512-lane tiles measured hbm_util 0.438 where the full-width slab
+# measured 0.259 — the sweep reproduces that layout via DLLAMA_W_MAX=512)
+PALLAS_W_MAX = int(_os.environ.get("DLLAMA_W_MAX", 8192))
 PALLAS_SUB = 512  # in-kernel dequant sub-tile (lanes)
 
 
