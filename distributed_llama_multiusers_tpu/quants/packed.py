@@ -113,6 +113,13 @@ import os as _os
 # 512-lane tiles measured hbm_util 0.438 where the full-width slab
 # measured 0.259 — the sweep reproduces that layout via DLLAMA_W_MAX=512)
 PALLAS_W_MAX = int(_os.environ.get("DLLAMA_W_MAX", 8192))
+if PALLAS_W_MAX <= 0 or PALLAS_W_MAX % 128 != 0:
+    # a non-128-multiple makes every plane silently take the XLA fallback
+    # (no tile candidate divides the planes), which would mislabel a sweep
+    # datapoint as kernel geometry — fail loudly instead
+    raise ValueError(
+        f"DLLAMA_W_MAX must be a positive multiple of 128, got {PALLAS_W_MAX}"
+    )
 PALLAS_SUB = 512  # in-kernel dequant sub-tile (lanes)
 
 
