@@ -17,21 +17,40 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_llama_multiusers_tpu.ops.pallas_q40 import (  # noqa: E402
-    SWEEP_COMBOS as COMBOS,  # the one shared DMA-geometry table
+    DEQUANT_MODES,
+    SWEEP_COMBOS,  # the one shared DMA-geometry table
 )
+
+# same candidate families as bench.py's in-bench sweep: dequant arithmetic
+# variants (the round-5 VPU-bound hypothesis), the round-2 narrow-tile
+# geometry, then the DMA-size combos
+CANDIDATES: dict[str, dict] = {
+    **{
+        f"dequant_{m}": {"DLLAMA_DEQUANT": m}
+        for m in DEQUANT_MODES if m != "v4"
+    },
+    "r02_narrow512": {
+        "DLLAMA_W_MAX": "512",
+        "DLLAMA_SINGLE_SLAB": "262144",
+        "DLLAMA_TARGET_BLOCK": "262144",
+    },
+    **{
+        n: {"DLLAMA_SINGLE_SLAB": str(s), "DLLAMA_TARGET_BLOCK": str(b)}
+        for n, (s, b) in SWEEP_COMBOS.items()
+    },
+}
 
 
 def main():
     budget = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = {}
-    for name, (slab, blk) in COMBOS.items():
+    for name, knobs in CANDIDATES.items():
         env = dict(
             os.environ,
             BENCH_CHILD="1",
             BENCH_PHASE="primary",
-            DLLAMA_SINGLE_SLAB=str(slab),
-            DLLAMA_TARGET_BLOCK=str(blk),
+            **knobs,
         )
         try:
             proc = subprocess.run(
