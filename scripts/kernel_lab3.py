@@ -165,6 +165,67 @@ def _k_blockdot(t_ref, xlt_ref, xht_ref, bs_ref, p_ref, s_ref, o_ref):
     o_ref[...] = acc + t_ref[0, 0]
 
 
+def _k_i8blockdot(t_ref, xlt_ref, xht_ref, aux_ref, p_ref, s_ref, o_ref):
+    """Q80-style int8 MXU dots: the raw nibbles (int8, no cast, no scale)
+    feed the MXU directly; activations arrive pre-quantized to int8 per
+    quant block (xq = round(x / sx), the reference's own activation
+    format). Per block b:
+
+        y += s_b * (sx[:,b,None] * (xq_lo_b @ nib_lo_b + xq_hi_b @ nib_hi_b)
+                    - 8 * bsum_b)
+
+    Per-weight VPU work = the 8-bit-lane mask ONLY (~0.5-1 op); the
+    rescale costs ~4*m/32 ops/weight. aux packs bsum and sx interleaved
+    on the sublane axis: aux[2b] = bsum[b], aux[2b+1] = sx[b]."""
+    rows, tile = p_ref.shape
+    n_blk = rows // 16
+    aux = aux_ref[...].reshape(n_blk, 2, M)
+    bs = aux[:, 0, :]  # [n_blk, M] f32
+    sx = aux[:, 1, :]  # [n_blk, M] f32
+    p8 = p_ref[...]
+    nib_lo = (p8 & jnp.uint8(0x0F)).astype(jnp.int8)
+    nib_hi = (p8 >> jnp.uint8(4)).astype(jnp.int8)
+    s = _f16_bits_to_f32(s_ref[...])  # [n_blk, tile]
+    xl = xlt_ref[...]  # [rows, M] int8
+    xh = xht_ref[...]
+    dn = (((0,), (0,)), ((), ()))
+    acc = None
+    for b in range(n_blk):
+        lo = jax.lax.dot_general(
+            xl[16 * b:16 * (b + 1), :], nib_lo[16 * b:16 * (b + 1), :], dn,
+            preferred_element_type=jnp.int32,
+        )
+        hi = jax.lax.dot_general(
+            xh[16 * b:16 * (b + 1), :], nib_hi[16 * b:16 * (b + 1), :], dn,
+            preferred_element_type=jnp.int32,
+        )
+        d = (lo + hi).astype(jnp.float32)  # [M, tile]
+        contrib = (sx[b][:, None] * d - 8.0 * bs[b][:, None]) * s[b][None, :]
+        acc = contrib if acc is None else acc + contrib
+    o_ref[...] = acc + t_ref[0, 0]
+
+
+def _quantize_x_blocks(xf, d_in):
+    """Reference-Q80-style per-block activation quantization for the
+    i8blockdot operands: returns (xq_lo_T, xq_hi_T int8 [half, M],
+    aux f32 [n_blk*2, M] with bsum/sx interleaved)."""
+    m = xf.shape[0]
+    n_blk = d_in // 32
+    xb = np.asarray(xf, np.float32).reshape(m, n_blk, 32)
+    sx = np.abs(xb).max(axis=2) / 127.0  # [m, n_blk]
+    sx = np.where(sx == 0, 1e-8, sx)
+    xq = np.clip(np.rint(xb / sx[:, :, None]), -127, 127).astype(np.int8)
+    bsum = xb.sum(axis=2)  # [m, n_blk] (EXACT x sums for the -8 fold)
+    xq_lo = xq[:, :, :16].reshape(m, d_in // 2)
+    xq_hi = xq[:, :, 16:].reshape(m, d_in // 2)
+    aux = np.empty((n_blk * 2, m), np.float32)
+    aux[0::2] = bsum.T
+    aux[1::2] = sx.T
+    return (
+        jnp.asarray(xq_lo.T), jnp.asarray(xq_hi.T), jnp.asarray(aux)
+    )
+
+
 def _k_u8nib(t_ref, xl_ref, xh_ref, bs_ref, p_ref, s_ref, o_ref):
     """Mask on native 8-bit lanes BEFORE any widening, then int8->bf16."""
     rows, tile = p_ref.shape
@@ -198,6 +259,31 @@ KERNELS = {
     "full_blockdot": (_k_blockdot, True),  # True: wants transposed x
     "full_u8nib": (_k_u8nib, False),
 }
+# i8blockdot is special-cased (int8 x operands + interleaved bsum/sx aux)
+
+
+def _call_i8blockdot(xf, packed, sbits, d_in, d_out, chunk, tile):
+    half = d_in // 2
+    xq_lo, xq_hi, aux = _quantize_x_blocks(np.asarray(xf), d_in)
+    t = jnp.zeros((1, 128), jnp.float32)
+    return pl.pallas_call(
+        lambda t_ref, a, b, c, p_, s_, o: _k_i8blockdot(t_ref, a, b, c, p_, s_, o),
+        grid=(d_out // tile, half // (chunk // 2)),
+        in_specs=[
+            pl.BlockSpec((1, 128), lambda j, k: (0, 0)),
+            pl.BlockSpec((chunk // 2, M), lambda j, k: (k, 0)),
+            pl.BlockSpec((chunk // 2, M), lambda j, k: (k, 0)),
+            pl.BlockSpec(((chunk // 32) * 2, M), lambda j, k: (k, 0)),
+            pl.BlockSpec((chunk // 2, tile), lambda j, k: (k, j)),
+            pl.BlockSpec((chunk // 32, tile), lambda j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((M, tile), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((M, d_out), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=_INTERPRET,
+    )(t, xq_lo, xq_hi, aux, packed, sbits)
 
 
 def _ref_dequant(packed, scales):
@@ -286,6 +372,13 @@ def check():
         ok = rel < 2e-2
         failed |= not ok
         print(f"{name:16s} max-rel-err {rel:.2e}  {'ok' if ok else 'FAIL'}")
+    # i8blockdot quantizes the ACTIVATIONS too (reference Q80 semantics) —
+    # looser bound than the weight-only variants
+    y = np.asarray(_call_i8blockdot(xf, packed, sb, d_in, d_out, chunk, tile))
+    rel = np.abs(y - y_ref).max() / (np.abs(y_ref).max() + 1e-9)
+    ok = rel < 5e-2
+    failed |= not ok
+    print(f"{'full_i8blockdot':16s} max-rel-err {rel:.2e}  {'ok' if ok else 'FAIL'}")
     if failed:
         sys.exit(1)
 
@@ -348,6 +441,25 @@ def main():
             )(t, xa, xb_, bsum_t, packed, sbits)
 
         timeit(name, call, pbytes)
+
+    # ---- i8blockdot: int8 MXU dots on Q80-quantized activations -----------
+    xq_lo, xq_hi, aux = _quantize_x_blocks(np.asarray(xf), d_in)
+    jax.block_until_ready((xq_lo, xq_hi, aux))
+    xi8_spec = pl.BlockSpec((CHUNK // 2, M), lambda l, j, k: (k, 0))
+    aux_spec = pl.BlockSpec(((CHUNK // 32) * 2, M), lambda l, j, k: (k, 0))
+
+    def call_i8(t):
+        def wrapped(t_ref, a, b, c, p_ref, s_ref, o_ref):
+            _k_i8blockdot(t_ref, a, b, c, p_ref.at[0], s_ref.at[0], o_ref)
+
+        return pl.pallas_call(
+            wrapped, grid=grid,
+            in_specs=[t_spec, xi8_spec, xi8_spec, aux_spec, p_spec, s_spec],
+            out_specs=o_spec, out_shape=o_shape,
+            compiler_params=params,
+        )(t, xq_lo, xq_hi, aux, packed, sbits)
+
+    timeit("full_i8blockdot", call_i8, pbytes)
 
     # ---- XLA-level int4 alternatives (no Pallas) --------------------------
     try:
