@@ -79,10 +79,18 @@ TARGET_BLOCK_BYTES = int(
 #              per-weight VPU chain shrinks to mask + cast (~2 ops), with
 #              the post-scale costing m/32 ops/weight (so decode-shaped m
 #              only: m > 32 falls back to bf16chain)
+#   i8blockdot blockdot with int8 MXU dots on Q80-QUANTIZED activations
+#              (the reference's own activation format: per-block int8 +
+#              f32 scale, src/llm.cpp:232-239): raw int8 nibbles feed the
+#              MXU with NO per-weight cast or mul — the only chain with a
+#              path to the DMA roofline. Numerics = reference Q80xQ40
+#              class (activations quantized), bounded by the mode parity
+#              test; same m cap as blockdot.
 # Exact-f32 dots (w_dtype=f32: parity gate, interpret tests) always use the
 # v4 f32 chain regardless of this knob.
 DEQUANT_MODE = _os.environ.get("DLLAMA_DEQUANT", "v4")
-DEQUANT_MODES = ("v4", "bf16chain", "repeat", "u8chain", "blockdot")
+DEQUANT_MODES = ("v4", "bf16chain", "repeat", "u8chain", "blockdot",
+                 "i8blockdot")
 BLOCKDOT_MAX_M = 32  # above this, the post-scale FMA outweighs the savings
 
 # The one shared DMA-geometry sweep table: (single-slab ceiling, k-chunk
@@ -321,6 +329,56 @@ def _q40_blockdot_kernel(xlt_ref, xht_ref, bsum_t_ref, packed_ref, scales_ref,
     _final_writeback(k, n_k, out_ref, acc_ref)
 
 
+def _q40_i8blockdot_kernel(xlt_ref, xht_ref, aux_ref, packed_ref, scales_ref,
+                           out_ref, acc_ref, *, sub_tiles, n_k):
+    """i8blockdot mode: per-block int8 MXU dots on Q80-quantized
+    activations. The raw int8 nibbles are the dot operand — the only
+    per-weight VPU work is the 8-bit-lane mask. Per block b:
+
+        y += s_b * (sx_b * (xq_lo_b @ nib_lo_b + xq_hi_b @ nib_hi_b)
+                    - 8 * bsum_b)
+
+    with sx the per-(lane, block) activation scale and bsum the EXACT f32
+    per-block x sums (the folded -8 offset stays exact; only the nibble
+    dot itself carries activation-quantization error — the reference's
+    Q80xQ40 numerics, src/llm.cpp:232-239). aux interleaves bsum/sx on
+    the sublane axis: aux[2b] = bsum[b], aux[2b+1] = sx[b]."""
+    rows, _ = packed_ref.shape
+    n_blk = rows // 16
+    k = pl.program_id(2)
+    m_t = xlt_ref.shape[1]
+    aux = aux_ref[...].reshape(n_blk, 2, m_t)
+    bs = aux[:, 0, :]  # [n_blk, m_tile] f32
+    sx = aux[:, 1, :]
+    xl = xlt_ref[...]  # [rows, m_tile] int8
+    xh = xht_ref[...]
+    dn = (((0,), (0,)), ((), ()))
+    off = 0
+    for t in sub_tiles:
+        p8 = packed_ref[:, off:off + t]
+        nib_lo = (p8 & jnp.uint8(0x0F)).astype(jnp.int8)
+        nib_hi = (p8 >> jnp.uint8(4)).astype(jnp.int8)
+        s = _f16_bits_to_f32(scales_ref[:, off:off + t])  # [n_blk, t]
+        part = None
+        for b in range(n_blk):
+            lo = jax.lax.dot_general(
+                xl[16 * b:16 * (b + 1), :],
+                nib_lo[16 * b:16 * (b + 1), :], dn,
+                preferred_element_type=jnp.int32,
+            )
+            hi = jax.lax.dot_general(
+                xh[16 * b:16 * (b + 1), :],
+                nib_hi[16 * b:16 * (b + 1), :], dn,
+                preferred_element_type=jnp.int32,
+            )
+            d = (lo + hi).astype(jnp.float32)
+            contrib = (sx[b][:, None] * d - 8.0 * bs[b][:, None]) * s[b][None, :]
+            part = contrib if part is None else part + contrib
+        _acc_epilogue(part, off, t, k, n_k, out_ref, acc_ref)
+        off += t
+    _final_writeback(k, n_k, out_ref, acc_ref)
+
+
 def pallas_supports(w: PackedQ40) -> bool:
     """True when the slab kernel handles these shapes; otherwise callers
     take the q40_matmul_xla fallback (ops/linear.py). d_in must cover whole
@@ -358,7 +416,7 @@ def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
     (prefill/training) fall back to bf16chain."""
     w_dtype_r = _resolve_w_dtype(w_dtype, interpret)
     mode = DEQUANT_MODE if w_dtype_r == jnp.bfloat16 else "v4"
-    if mode == "blockdot":
+    if mode in ("blockdot", "i8blockdot"):
         m = 1
         for s_ in x.shape[:-1]:
             m *= s_
@@ -413,15 +471,37 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
 
     scale_bits = jax.lax.bitcast_convert_type(w.scales, jnp.int16)
 
+    aux_spec = pl.BlockSpec((rows // 16, m_tile), lambda i, j, k: (k, i))
     if mode == "blockdot":
         # x TRANSPOSED [rows, m]: the kernel slices 16-row (one quant
         # block) ranges, which must land on the sublane axis — sub-128
         # lane slices would relayout
         xa, xb_ = x_lo.T, x_hi.T
+        aux = bsum_t
         x_spec = pl.BlockSpec((rows, m_tile), lambda i, j, k: (k, i))
         kernel = partial(_q40_blockdot_kernel, sub_tiles=sub, n_k=n_k)
+    elif mode == "i8blockdot":
+        # Q80-style per-block activation quantization, in the surrounding
+        # jit (O(m*d_in)); x TRANSPOSED like blockdot; bsum (EXACT f32
+        # sums) and the activation scales interleave on the sublane axis
+        xq3 = xf.reshape(m_pad, n_blk_total, 32)
+        sx = jnp.maximum(jnp.abs(xq3).max(axis=2), 1e-8) / 127.0
+        xq = jnp.clip(
+            jnp.round(xq3 / sx[:, :, None]), -127, 127
+        ).astype(jnp.int8)
+        xa = xq[:, :, :16].reshape(m_pad, half).T
+        xb_ = xq[:, :, 16:].reshape(m_pad, half).T
+        aux = jnp.stack([bsum_t.T, sx], axis=2).reshape(
+            m_pad, n_blk_total * 2
+        ).T  # aux[2b] = bsum[b], aux[2b+1] = sx[b]
+        aux_spec = pl.BlockSpec(
+            ((rows // 16) * 2, m_tile), lambda i, j, k: (k, i)
+        )
+        x_spec = pl.BlockSpec((rows, m_tile), lambda i, j, k: (k, i))
+        kernel = partial(_q40_i8blockdot_kernel, sub_tiles=sub, n_k=n_k)
     else:
         xa, xb_ = x_lo, x_hi
+        aux = bsum_t
         x_spec = pl.BlockSpec((m_tile, rows), lambda i, j, k: (i, k))
         kernel = partial(_q40_slab_kernel, w_dtype=w_dtype, sub_tiles=sub,
                          n_k=n_k, mode=mode)
@@ -432,7 +512,7 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
         in_specs=[
             x_spec,
             x_spec,
-            pl.BlockSpec((rows // 16, m_tile), lambda i, j, k: (k, i)),
+            aux_spec,
             pl.BlockSpec((rows, w_tile), lambda i, j, k: (k, j)),
             pl.BlockSpec((rows // 16, w_tile), lambda i, j, k: (k, j)),
         ],
@@ -451,7 +531,7 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
             transcendentals=0,
         ),
         interpret=interpret,
-    )(xa, xb_, bsum_t, w.packed, scale_bits)
+    )(xa, xb_, aux, w.packed, scale_bits)
 
     return out[:m].reshape(*lead, d_out)
 

@@ -216,6 +216,10 @@ def test_dequant_mode_variants_close():
     pw = _pack(rng, 256, 128)
     x = jnp.asarray(rng.standard_normal((4, 128), dtype=np.float32))
     exact = np.asarray(q40_matmul_pallas(x, pw, interpret=True))
+    # per-mode error class: bf16-rounding-only chains sit at ~5e-3;
+    # i8blockdot ALSO quantizes the activations (reference Q80 class,
+    # ~1e-2 mean / 1.6e-2 max over seeds) so it gets the lab's bound
+    bound = {"i8blockdot": 5e-2}
     try:
         for mode in DEQUANT_MODES:
             set_dequant_mode(mode)
@@ -226,7 +230,7 @@ def test_dequant_mode_variants_close():
             # not the output element (cancellation leaves small outputs
             # with proportionally larger error) — bound it vs max|y|
             rel = np.abs(got - exact).max() / (np.abs(exact).max() + 1e-9)
-            assert rel < 2e-2, f"mode {mode}: max-rel {rel:.3e}"
+            assert rel < bound.get(mode, 2e-2), f"mode {mode}: max-rel {rel:.3e}"
             # exact-f32 dots ignore the mode knob entirely
             f32 = np.asarray(q40_matmul_pallas(x, pw, interpret=True))
             np.testing.assert_array_equal(f32, exact, err_msg=f"mode {mode}")
