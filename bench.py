@@ -1039,6 +1039,7 @@ def main() -> None:
         combos = candidates[:7]
         for n, _ in candidates[7:]:  # no silent caps
             errors.append(f"sweep[{n}]: skipped (combo cap)")
+        best_env: dict = {}
         for name, env in combos:
             budget = min(300.0, deadline - time.monotonic() - 10)
             if budget < 90:
@@ -1057,6 +1058,7 @@ def main() -> None:
                         if k in result
                     })
                     merged["kernel_knobs"] = name
+                    best_env = env
                     # keep the headline ratio consistent with the adopted
                     # value (the 8b matched-model overwrite below may still
                     # supersede it)
@@ -1071,13 +1073,19 @@ def main() -> None:
         if sweep:
             bank({"kernel_sweep": sweep})
 
-        # parity last — see the phase-order comment above
+        # parity last — see the phase-order comment above. It runs under
+        # the ADOPTED sweep knobs (if any), so the token-identity gate
+        # describes the same configuration as the headline number
         budget = min(300.0, deadline - time.monotonic() - 10)
         if tunnel_dead:
             errors.append("parity: skipped (tunnel died mid-sweep)")
         elif budget >= 90:
-            result, err = _run_child({"BENCH_PHASE": "parity"}, budget)
+            result, err = _run_child(
+                {"BENCH_PHASE": "parity", **best_env}, budget
+            )
             if result is not None:
+                if best_env:
+                    result["parity_knobs"] = merged.get("kernel_knobs")
                 bank(result)
             else:
                 errors.append(f"parity: {err}")
