@@ -114,6 +114,66 @@ def chat_chunk_response(
     }
 
 
+def parse_completion_prompt(body: dict) -> str:
+    """Raw prompt for /v1/completions: a string, or a 1-element list of
+    strings (the OpenAI API's batched-prompt form; >1 is unsupported —
+    submit them as separate requests, the batching loop runs them
+    concurrently anyway)."""
+    prompt = body.get("prompt")
+    if isinstance(prompt, list):
+        if len(prompt) > 1:
+            raise ValueError(
+                "prompt lists with more than one entry are unsupported; "
+                "submit separate requests (they batch concurrently)"
+            )
+        prompt = prompt[0] if prompt else None
+    if not isinstance(prompt, str) or not prompt:
+        raise ValueError(
+            "missing or empty 'prompt' (must be a non-empty string or a "
+            "1-element list of strings; token-id prompts are unsupported)"
+        )
+    return prompt
+
+
+def completion_response(
+    model: str, req_id: int, text: str, prompt_tokens: int, completion_tokens: int,
+    finish_reason: str = "stop",
+) -> dict:
+    return {
+        "id": f"cmpl-{req_id}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "generated_text": text,  # fork-compat field, same as the chat route
+        "choices": [
+            {"index": 0, "text": text, "finish_reason": finish_reason}
+        ],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
+        },
+    }
+
+
+def completion_chunk_response(
+    model: str, req_id: int, delta: str | None, done: bool, finish_reason: str = "stop"
+) -> dict:
+    return {
+        "id": f"cmpl-{req_id}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "text": delta or "",
+                "finish_reason": finish_reason if done else None,
+            }
+        ],
+    }
+
+
 def models_response(model: str) -> dict:
     return {
         "object": "list",

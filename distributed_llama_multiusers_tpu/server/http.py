@@ -1,7 +1,9 @@
 """Multi-user HTTP API server.
 
 Routes match the reference's dllama-api (src/dllama-api.cpp:338-349):
-POST /v1/chat/completions and GET /v1/models, with CORS preflight.
+POST /v1/chat/completions and GET /v1/models, with CORS preflight —
+plus, beyond parity: POST /v1/completions (raw-prompt text completion,
+no chat template), GET /stats, and GET /health.
 
 Concurrency model is where this departs from the fork: the fork accepts one
 connection at a time and blocks the accept loop on future.get()
@@ -34,17 +36,13 @@ class ApiServer:
 
     # -- request handling ---------------------------------------------------
 
-    def build_request(self, body: dict, streaming: bool) -> tuple[Request, "queue.Queue[str | None]"]:
-        """Validate the body and build the Request. Raises ValueError on bad
-        input — callers must do this BEFORE committing response headers."""
-        messages = api_types.parse_chat_messages(body)
+    def _make_request(self, prompt: str, body: dict, streaming: bool) -> tuple[Request, "queue.Queue[str | None]"]:
+        """Shared Request construction for both routes (one place owns the
+        body->Request field mapping)."""
         params = api_types.InferenceParams.from_body(body)
-        chat = self.chat_template.generate(
-            [ChatItem(m.role, m.content) for m in messages], append_generation_prompt=True
-        )
         deltas: "queue.Queue[str | None]" = queue.Queue()
         req = Request(
-            prompt=chat.content,
+            prompt=prompt,
             max_tokens=params.max_tokens,
             temperature=params.temperature,
             topp=params.top_p,
@@ -54,10 +52,39 @@ class ApiServer:
         )
         return req, deltas
 
+    def build_request(self, body: dict, streaming: bool) -> tuple[Request, "queue.Queue[str | None]"]:
+        """Validate the body and build the Request. Raises ValueError on bad
+        input — callers must do this BEFORE committing response headers."""
+        messages = api_types.parse_chat_messages(body)
+        chat = self.chat_template.generate(
+            [ChatItem(m.role, m.content) for m in messages], append_generation_prompt=True
+        )
+        return self._make_request(chat.content, body, streaming)
+
+    def build_completion_request(self, body: dict, streaming: bool) -> tuple[Request, "queue.Queue[str | None]"]:
+        """/v1/completions: the raw prompt goes straight to the scheduler —
+        no chat template. Beyond reference parity (the fork serves only
+        the chat route, src/dllama-api.cpp:338-349)."""
+        prompt = api_types.parse_completion_prompt(body)
+        return self._make_request(prompt, body, streaming)
+
     def handle_chat_completion(self, body: dict, send_chunk=None, prepared=None) -> dict:
         """Run a (pre-validated) request through the shared batching loop.
         If send_chunk is given, stream deltas through it."""
         req, deltas = prepared if prepared is not None else self.build_request(body, send_chunk is not None)
+        return self._run_request(
+            req, deltas, send_chunk,
+            api_types.chat_chunk_response, api_types.chat_completion_response,
+        )
+
+    def handle_completion(self, body: dict, send_chunk=None, prepared=None) -> dict:
+        req, deltas = prepared if prepared is not None else self.build_completion_request(body, send_chunk is not None)
+        return self._run_request(
+            req, deltas, send_chunk,
+            api_types.completion_chunk_response, api_types.completion_response,
+        )
+
+    def _run_request(self, req, deltas, send_chunk, chunk_fn, response_fn) -> dict:
         self.scheduler.submit(req)
 
         if send_chunk:
@@ -67,10 +94,10 @@ class ApiServer:
                     delta = deltas.get()
                     if delta is None:
                         break
-                    send_chunk(api_types.chat_chunk_response(self.model_name, req.id, delta, False))
+                    send_chunk(chunk_fn(self.model_name, req.id, delta, False))
                 req.future.result()  # re-raise failures
                 send_chunk(
-                    api_types.chat_chunk_response(
+                    chunk_fn(
                         self.model_name, req.id, None, True, req.finish_reason or "stop"
                     )
                 )
@@ -82,7 +109,7 @@ class ApiServer:
             return {}
 
         text = req.future.result()
-        return api_types.chat_completion_response(
+        return response_fn(
             self.model_name, req.id, text, req.n_prompt_tokens, len(req.generated_tokens),
             req.finish_reason or "stop",
         )
@@ -165,9 +192,19 @@ class ApiServer:
                     self._json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/v1/chat/completions":
+                routes = {
+                    "/v1/chat/completions": (
+                        api.build_request, api.handle_chat_completion
+                    ),
+                    "/v1/completions": (
+                        api.build_completion_request, api.handle_completion
+                    ),
+                }
+                route = routes.get(self.path)
+                if route is None:
                     self._json(404, {"error": "not found"})
                     return
+                build_fn, handle_fn = route
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -178,7 +215,7 @@ class ApiServer:
                     if body.get("stream"):
                         # validate BEFORE committing SSE headers so bad input
                         # still gets a proper 400
-                        prepared = api.build_request(body, streaming=True)
+                        prepared = build_fn(body, streaming=True)
                         self.send_response(200)
                         self._cors()
                         self.send_header("Content-Type", "text/event-stream")
@@ -191,7 +228,7 @@ class ApiServer:
                             self.wfile.flush()
 
                         try:
-                            api.handle_chat_completion(body, send_chunk=send_chunk, prepared=prepared)
+                            handle_fn(body, send_chunk=send_chunk, prepared=prepared)
                             self.wfile.write(b"data: [DONE]\n\n")
                         except (BrokenPipeError, ConnectionError, OSError):
                             return  # client gone; request already cancelled
@@ -199,7 +236,7 @@ class ApiServer:
                             send_chunk({"error": str(e)})
                             self.wfile.write(b"data: [DONE]\n\n")
                     else:
-                        self._json(200, api.handle_chat_completion(body))
+                        self._json(200, handle_fn(body))
                 except ValueError as e:
                     self._json(400, {"error": str(e)})
                 except Exception as e:  # generation failure

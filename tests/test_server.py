@@ -168,3 +168,58 @@ def test_stats_endpoint(server):
     assert 0 <= body["lanes_busy"] <= body["lanes_total"]
     assert "spec_tokens_per_lane_step" in body
     assert "spec_lane_steps" in body
+
+
+def test_text_completion(server):
+    """/v1/completions (beyond parity): raw prompt, no chat template."""
+    status, body = post(
+        server + "/v1/completions",
+        {"prompt": "hello world", "max_tokens": 6, "temperature": 0},
+    )
+    assert status == 200
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["text"] == body["generated_text"]
+    assert body["usage"]["completion_tokens"] <= 6
+    # 1-element list form is accepted; longer lists are a clean 400
+    status2, body2 = post(
+        server + "/v1/completions",
+        {"prompt": ["hello world"], "max_tokens": 6, "temperature": 0},
+    )
+    assert status2 == 200 and body2["generated_text"] == body["generated_text"]
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as e3:
+        post(server + "/v1/completions", {"prompt": ["a", "b"], "max_tokens": 4})
+    assert e3.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e4:
+        post(server + "/v1/completions", {"max_tokens": 4})
+    assert e4.value.code == 400
+
+
+def test_text_completion_streaming(server):
+    import urllib.request
+
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps(
+            {"prompt": "hello world", "max_tokens": 6, "temperature": 0,
+             "stream": True}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    chunks = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        for line in r:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                chunks.append(line[6:])
+    assert chunks[-1] == "[DONE]"
+    payloads = [json.loads(c) for c in chunks[:-1]]
+    assert payloads[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+    streamed = "".join(p["choices"][0]["text"] for p in payloads)
+    _, full = post(
+        server + "/v1/completions",
+        {"prompt": "hello world", "max_tokens": 6, "temperature": 0},
+    )
+    assert streamed == full["generated_text"]
