@@ -95,6 +95,9 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                    help="train: UTF-8 text file tokenized into training batches")
     p.add_argument("--train-steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="train: linear LR warmup steps, then cosine decay "
+                        "to 10%% of --lr over --train-steps (0 = flat --lr)")
     p.add_argument("--batch-size", type=int, default=4)
     p.add_argument("--train-seq-len", type=int, default=0,
                    help="tokens per training sequence (0 = model seq_len)")

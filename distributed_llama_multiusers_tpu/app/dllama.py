@@ -294,7 +294,21 @@ def run_train(args) -> None:
         params = shard_params(params, mesh)
         log("🕸", f"Training over mesh {dict(mesh.shape)}")
 
-    trainer = Trainer(config, params, optax.adamw(args.lr), mesh=mesh)
+    # LR schedule: linear warmup into cosine decay to 10% of peak over the
+    # full run (--warmup-steps 0 keeps the flat --lr). The schedule count
+    # lives in the optax state, so checkpoints resume it exactly.
+    warmup = getattr(args, "warmup_steps", 0) or 0
+    if warmup > 0:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=args.lr,
+            warmup_steps=warmup,
+            decay_steps=max(args.train_steps, warmup + 1),
+            end_value=args.lr * 0.1,
+        )
+    else:
+        lr = args.lr
+    trainer = Trainer(config, params, optax.adamw(lr), mesh=mesh)
     if args.ckpt_dir and Trainer.latest_step(args.ckpt_dir) is not None:
         trainer.restore(args.ckpt_dir)
         log("💾", f"Resumed from step {trainer.step_count} in {args.ckpt_dir}")

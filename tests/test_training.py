@@ -73,6 +73,42 @@ def test_save_restore_resume_is_exact(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_scheduled_lr_resume_is_exact(tmp_path):
+    """Resume exactness must hold for a SCHEDULED learning rate too: the
+    schedule's step count lives in the optax state, so a restored run
+    continues the warmup/decay curve exactly where it left off (this is
+    what --warmup-steps relies on)."""
+    config = _config()
+    batches = _batches(config, 4)
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=1e-3, warmup_steps=2, decay_steps=6,
+        end_value=1e-4,
+    )
+
+    def make():
+        params = jax.tree.map(
+            jnp.asarray, params_from_random(config, seed=1, to_device=False)
+        )
+        return Trainer(config, params, optax.adamw(sched))
+
+    straight = make()
+    for b in batches:
+        straight.step(b)
+
+    resumed = make()
+    for b in batches[:2]:
+        resumed.step(b)
+    resumed.save(str(tmp_path))
+
+    fresh = make()
+    fresh.restore(str(tmp_path))
+    for b in batches[2:]:
+        fresh.step(b)
+
+    for a, b in zip(jax.tree.leaves(straight.params), jax.tree.leaves(fresh.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_latest_step_selection(tmp_path):
     config = _config()
     t = _trainer(config)
