@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test sanitize clean
+.PHONY: all native test verify sanitize clean
 
 all: native
 
@@ -15,6 +15,20 @@ $(NATIVE_SO): $(NATIVE_DIR)/quant_codec.cpp
 
 test: native
 	python -m pytest tests/ -x -q
+
+# Canonical tier-1 gate (the exact command from ROADMAP.md) — the one
+# entry point builders and CI invoke; keep in sync with ROADMAP.md.
+# Depends on native like `test` does: without the .so the native codec
+# tests skip and the gate would report success with less coverage.
+verify: SHELL := /bin/bash
+verify: native
+	set -o pipefail; log=$$(mktemp /tmp/_t1.XXXXXX.log); \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee $$log; \
+	rc=$$?; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' $$log | tr -cd . | wc -c); \
+	rm -f $$log; exit $$rc
 
 # ASan+UBSan gate for the native codec (the reference's sanitizer-CI
 # analogue, SURVEY.md §5.2): rebuilds the .so instrumented and reruns the

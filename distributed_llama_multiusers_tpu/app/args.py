@@ -84,6 +84,20 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "request shares at least this many leading prompt "
                         "tokens (prefix caching); 0 disables; default: "
                         "scheduler default (16)")
+    # serving QoS (serving/ package): bounded admission + deadlines
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="serving: max requests waiting for a lane before "
+                        "submissions are shed with HTTP 429 + Retry-After "
+                        "(bounded admission; 0 = unbounded)")
+    p.add_argument("--queue-timeout", type=float, default=0.0,
+                   help="serving: seconds a request may wait queued before "
+                        "finishing with finish_reason=timeout instead of "
+                        "holding the client open (0 disables)")
+    p.add_argument("--request-budget", type=float, default=0.0,
+                   help="serving: wall-clock seconds a request may spend "
+                        "generating after admission; exceeding it finishes "
+                        "with finish_reason=timeout and frees the lane "
+                        "(0 disables)")
     p.add_argument("--multi-step", type=int, default=None,
                    help="serving: chain up to this many decode steps per "
                         "device dispatch in steady-state decode (identical "

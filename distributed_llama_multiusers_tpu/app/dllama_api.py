@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 
 from ..server import ApiServer
 from ..tokenizer import template_type_from_name
@@ -34,8 +35,21 @@ def main(argv=None) -> None:
     except KeyboardInterrupt:
         pass
     finally:
-        httpd.shutdown()
-        scheduler.stop()
+        # drain WHILE the server still answers — the accept loop restarts in
+        # a helper thread so /health serves 503 and new submissions shed with
+        # 503 + Retry-After (load balancers route away) instead of new
+        # connections hanging in the accept backlog for the whole window.
+        # drain() owns the whole shutdown protocol, including force-stop on
+        # timeout — a second stop() here would only re-join a thread drain
+        # already dealt with (and re-raise over drain's own failure report
+        # when that thread is wedged in a hung device dispatch).
+        accept_loop = threading.Thread(target=httpd.serve_forever, daemon=True)
+        accept_loop.start()
+        try:
+            log("⭐", "Draining in-flight requests (30s window)")
+            scheduler.drain(timeout=30.0)
+        finally:
+            httpd.shutdown()
 
 
 if __name__ == "__main__":

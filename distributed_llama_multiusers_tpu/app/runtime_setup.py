@@ -190,6 +190,7 @@ def load_stack(args, n_lanes: int | None = None):
 
 def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
     from ..runtime.engine import warmup_engine
+    from ..serving import DeadlinePolicy, QosQueue
 
     speculative = not getattr(args, "no_spec", False)
     # pass prefix_min_tokens/multi_step only when the CLI provided them: the
@@ -201,10 +202,21 @@ def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
         overrides["prefix_min_tokens"] = pmt
     if ms is not None:
         overrides["multi_step"] = ms
+    # QoS surface (--max-queue / --queue-timeout / --request-budget):
+    # bounded admission with per-user fair share, plus deadlines
+    max_queue = getattr(args, "max_queue", 0) or 0
+    policy = DeadlinePolicy.from_args(args) if args is not None else DeadlinePolicy()
+    log(
+        "🚦",
+        f"QoS: queue capacity {max_queue or 'unbounded'}, "
+        f"queue timeout {policy.queue_timeout_s or 'off'}, "
+        f"request budget {policy.request_budget_s or 'off'}",
+    )
     log("⏳", "Warming serving programs (prefill buckets, decode, spec)...")
     t0 = time.perf_counter()
     sched = ContinuousBatchingScheduler(
-        engine, tokenizer, speculative=speculative, **overrides,
+        engine, tokenizer, speculative=speculative,
+        queue_=QosQueue(capacity=max_queue), deadlines=policy, **overrides,
     )
     warmup_engine(engine, spec=speculative, multi_step=sched.multi_step)
     log("⏳", f"Warmup done in {time.perf_counter() - t0:.1f}s")

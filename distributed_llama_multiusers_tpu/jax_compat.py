@@ -1,0 +1,27 @@
+"""jax version compatibility for ``shard_map``.
+
+jax >= 0.6 spells the replication-check kwarg ``check_vma``; older
+versions spell it ``check_rep`` (and the oldest only export shard_map
+from ``jax.experimental``). The kwarg is detected by signature, not by
+import location — some versions export top-level ``jax.shard_map`` while
+still spelling the kwarg ``check_rep``. Every shard_map call site in the
+package imports from here so both spellings work.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pre-top-level-export versions
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+        )

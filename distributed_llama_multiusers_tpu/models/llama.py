@@ -31,6 +31,7 @@ from ..ops.activations import gelu, silu
 from ..ops.linear import matmul
 from ..ops.norm import rms_norm
 from ..ops.rope import apply_rope
+from ..jax_compat import shard_map
 from .config import LlamaConfig
 
 
@@ -196,7 +197,7 @@ def _moe_ffn_ep_packed(yq, rw, w1, w2, w3, act_fn, maybe_qdq, mesh):
             out = term if out is None else out + term
         return jax.lax.psum(out, ("ep", "tp"))
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(

@@ -48,6 +48,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.6 spells pltpu.CompilerParams "TPUCompilerParams" (same kwargs)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 from ..quants.packed import (
     PALLAS_SUB as SUB_TILE,
     PackedQ40,
@@ -521,7 +524,7 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
         scratch_shapes=[
             pltpu.VMEM((m_tile, w_tile if n_k > 1 else SUB_TILE), jnp.float32)
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -620,18 +623,27 @@ def _q40_mm_partition(interpret, w_dtype, mesh, arg_shapes, result_shape):
 
 
 _q40_mm = custom_partitioning(_q40_mm_impl, static_argnums=(3, 4))
-_q40_mm.def_partition(
-    partition=_q40_mm_partition,
-    infer_sharding_from_operands=_q40_mm_infer_sharding,
-    # x [..., (b*32)], packed [(b*16), n], scales [b, n] -> [..., n]:
-    # b = quant blocks of the contraction (reduction); the intra-block
-    # subfactors must never be split across devices
-    sharding_rule="... (b t), (b s) n, b n -> ... n",
-    reduction_factors=("b",),
-    need_replication_factors=("t", "s"),
-    t=32,
-    s=16,
-)
+try:
+    _q40_mm.def_partition(
+        partition=_q40_mm_partition,
+        infer_sharding_from_operands=_q40_mm_infer_sharding,
+        # x [..., (b*32)], packed [(b*16), n], scales [b, n] -> [..., n]:
+        # b = quant blocks of the contraction (reduction); the intra-block
+        # subfactors must never be split across devices
+        sharding_rule="... (b t), (b s) n, b n -> ... n",
+        reduction_factors=("b",),
+        need_replication_factors=("t", "s"),
+        t=32,
+        s=16,
+    )
+except TypeError:
+    # older jax: no shardy sharding_rule/factor kwargs — GSPMD partitions
+    # through the infer/partition callbacks alone, which carry the same
+    # constraints, so dropping the rule only loses shardy support
+    _q40_mm.def_partition(
+        partition=_q40_mm_partition,
+        infer_sharding_from_operands=_q40_mm_infer_sharding,
+    )
 
 
 def q40_matmul_partitioned(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,

@@ -14,7 +14,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ..jax_compat import shard_map
 
 from ..quants.jax_codec import Q80_BLOCK, q80_decode_blocks, q80_encode_blocks
 

@@ -20,6 +20,7 @@ the device-resident cache it threads through.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -61,15 +62,31 @@ class EngineStats:
     # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
     sync_bytes_per_decode: int = 0
     sync_collectives_per_decode: int = 0
+    # writers (engine hot paths, scheduler counters) hold this around their
+    # multi-field bumps; snapshot()/reset() hold it while copying, so a
+    # /stats read sees one consistent point in time instead of field-by-field
+    # values racing the batching thread
+    lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def _counters(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if k != "lock"}
+
+    def snapshot(self) -> dict:
+        """Consistent point-in-time copy of every counter (one lock hold)."""
+        with self.lock:
+            return self._counters()
 
     def reset(self) -> "EngineStats":
-        snap = EngineStats(**self.__dict__)
-        self.prefill_s = self.decode_s = 0.0
-        self.prefill_tokens = self.decode_steps = self.host_bytes_in = 0
-        self.spec_steps = self.spec_emitted = self.spec_lane_steps = 0
-        self.prefix_hits = self.prefix_tokens_saved = 0
-        self.multi_dispatches = 0
-        # sync_* stay: they describe the compiled program, not a window
+        with self.lock:
+            snap = EngineStats(**self._counters())
+            self.prefill_s = self.decode_s = 0.0
+            self.prefill_tokens = self.decode_steps = self.host_bytes_in = 0
+            self.spec_steps = self.spec_emitted = self.spec_lane_steps = 0
+            self.prefix_hits = self.prefix_tokens_saved = 0
+            self.multi_dispatches = 0
+            # sync_* stay: they describe the compiled program, not a window
         return snap
 
     def preserved(self):
@@ -79,11 +96,12 @@ class EngineStats:
 
         @contextlib.contextmanager
         def cm():
-            snap = dict(self.__dict__)
+            snap = self.snapshot()
             try:
                 yield self
             finally:
-                self.__dict__.update(snap)
+                with self.lock:
+                    self.__dict__.update(snap)
 
         return cm()
 
@@ -406,9 +424,10 @@ class InferenceEngine:
         toks_np = np.asarray(toks)  # one [2] transfer: greedy, sampled
         greedy = int(toks_np[0])
         sampled = int(toks_np[1])
-        self.stats.host_bytes_in += toks_np.nbytes
-        self.stats.prefill_s += time.perf_counter() - t0
-        self.stats.prefill_tokens += len(chunk)
+        with self.stats.lock:
+            self.stats.host_bytes_in += toks_np.nbytes
+            self.stats.prefill_s += time.perf_counter() - t0
+            self.stats.prefill_tokens += len(chunk)
         return last, greedy, sampled
 
     def prefill(
@@ -469,9 +488,10 @@ class InferenceEngine:
         )
         toks_np = np.asarray(toks)  # ONE [2, n] transfer: greedy, sampled
         greedy_np, sampled_np = toks_np[0], toks_np[1]
-        self.stats.host_bytes_in += toks_np.nbytes
-        self.stats.decode_s += time.perf_counter() - t0
-        self.stats.decode_steps += 1
+        with self.stats.lock:
+            self.stats.host_bytes_in += toks_np.nbytes
+            self.stats.decode_s += time.perf_counter() - t0
+            self.stats.decode_steps += 1
         return logits, greedy_np, sampled_np
 
     # pod roots broadcast multi-step decodes as OP_DECODE_MULTI packets
@@ -521,10 +541,11 @@ class InferenceEngine:
             jnp.asarray(seeds, jnp.uint32),
         )
         chosen_np = np.asarray(chosen)  # ONE [h, n] transfer
-        self.stats.host_bytes_in += chosen_np.nbytes
-        self.stats.decode_s += time.perf_counter() - t0
-        self.stats.decode_steps += h
-        self.stats.multi_dispatches += 1
+        with self.stats.lock:
+            self.stats.host_bytes_in += chosen_np.nbytes
+            self.stats.decode_s += time.perf_counter() - t0
+            self.stats.decode_steps += h
+            self.stats.multi_dispatches += 1
         return chosen_np
 
     # drafts per speculative step (K = SPEC_DRAFT + 1 verified tokens)
@@ -576,10 +597,11 @@ class InferenceEngine:
         )
         out_np = np.asarray(packed_out)  # ONE [n, K+1] transfer
         emitted, n_emit = out_np[:, :-1], out_np[:, -1]
-        self.stats.host_bytes_in += out_np.nbytes
-        self.stats.decode_s += time.perf_counter() - t0
-        self.stats.decode_steps += 1
-        self.stats.spec_steps += 1
+        with self.stats.lock:
+            self.stats.host_bytes_in += out_np.nbytes
+            self.stats.decode_s += time.perf_counter() - t0
+            self.stats.decode_steps += 1
+            self.stats.spec_steps += 1
         return logits, emitted, n_emit
 
     def sample_token(
@@ -594,7 +616,8 @@ class InferenceEngine:
             jnp.uint32(seed & 0xFFFFFFFF),
             jnp.int32(pos),
         )
-        self.stats.host_bytes_in += 4
+        with self.stats.lock:
+            self.stats.host_bytes_in += 4
         return int(tok)
 
     def collective_stats(self, refresh: bool = False) -> dict:
@@ -623,8 +646,9 @@ class InferenceEngine:
         # keep the executable for dispatch: decode shapes never change, so
         # this one AOT compile replaces the jit path's own compile
         self._decode_exec = compiled
-        self.stats.sync_bytes_per_decode = stats.get("total_bytes", 0)
-        self.stats.sync_collectives_per_decode = stats.get("n_collectives", 0)
+        with self.stats.lock:
+            self.stats.sync_bytes_per_decode = stats.get("total_bytes", 0)
+            self.stats.sync_collectives_per_decode = stats.get("n_collectives", 0)
         self._coll_stats = stats
         return stats
 
@@ -654,13 +678,15 @@ class InferenceEngine:
     def lane_logits(self, logits, lane: int) -> np.ndarray:
         """Transfer one lane's logits to host (counted, for sampling)."""
         out = np.asarray(logits[lane])
-        self.stats.host_bytes_in += out.nbytes
+        with self.stats.lock:
+            self.stats.host_bytes_in += out.nbytes
         return out
 
     def all_logits(self, logits) -> np.ndarray:
         """Single batched device->host transfer of all lanes' logits."""
         out = np.asarray(logits)
-        self.stats.host_bytes_in += out.nbytes
+        with self.stats.lock:
+            self.stats.host_bytes_in += out.nbytes
         return out
 
     def copy_lane(self, src: int, dst: int) -> None:

@@ -11,10 +11,15 @@ in SURVEY.md §2.3; this implementation is the corrected design:
   (d) clean shutdown via stop() — the loop thread joins
   (e) streaming decode through per-lane StreamDecoder + EosDetector
 
-Flow: HTTP/CLI threads push Request objects into RequestQueue; the scheduler
-thread drains the queue into free lanes (prefill), then advances ALL active
-lanes one token per engine.decode() step, sampling per-lane, emitting stream
-deltas, and fulfilling each request's future on EOS / max_tokens.
+Flow: HTTP/CLI threads push Request objects into the queue (by default a
+serving.QosQueue — bounded admission, priority classes, per-user
+deficit-round-robin fair share; the bare RequestQueue FIFO remains for
+strict reference-parity use); the scheduler thread drains the queue into
+free lanes (prefill), then advances ALL active lanes one token per
+engine.decode() step, sampling per-lane, emitting stream deltas, and
+fulfilling each request's future on EOS / max_tokens. Deadlines
+(serving/deadlines.py) bound queue wait and generation wall-clock;
+drain() (serving/drain.py) is the graceful-shutdown counterpart to stop().
 """
 
 from __future__ import annotations
@@ -30,6 +35,15 @@ from typing import Callable
 
 import numpy as np
 
+from ..serving import (
+    AdmissionRejected,
+    DeadlinePolicy,
+    Priority,
+    QosQueue,
+    budget_expired,
+    drain_scheduler,
+    queue_expired,
+)
 from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerChatStops
 from .spec import NgramDraftIndex
 
@@ -58,6 +72,12 @@ class Request:
     stop: list[str] = field(default_factory=list)
     add_bos: bool = True
     add_special_tokens: bool = True
+    # QoS identity (serving/qos.py): fair-share key + admission class
+    user_id: str = ""
+    priority: int = Priority.NORMAL
+    # per-request deadline overrides (serving/deadlines.py); None = policy
+    queue_timeout_s: float | None = None
+    budget_s: float | None = None
     id: int = field(default_factory=lambda: next(_req_ids))
     state: RequestState = RequestState.QUEUED
     future: Future = field(default_factory=Future)
@@ -67,7 +87,9 @@ class Request:
     generated_tokens: list[int] = field(default_factory=list)
     n_prompt_tokens: int = 0
     error: str | None = None
-    finish_reason: str | None = None  # "stop" | "length" | "cancelled"
+    finish_reason: str | None = None  # "stop" | "length" | "cancelled" | "timeout"
+    submitted_at: float | None = None  # monotonic, stamped by submit()/push()
+    admitted_at: float | None = None  # monotonic, stamped at lane claim
     _cancelled: threading.Event = field(default_factory=threading.Event)
 
     def cancel(self) -> None:
@@ -103,6 +125,18 @@ class RequestQueue:
                 out.append(self._q.get_nowait())
             except queue.Empty:
                 return out
+
+    def remove_if(self, predicate) -> list[Request]:
+        """Remove and return every queued request matching ``predicate``
+        (same contract as QosQueue.remove_if) — the scheduler's deadline
+        sweep and the submit()/drain() race both need targeted removal,
+        on this queue no less than on the QoS one."""
+        with self._q.mutex:
+            q = self._q.queue
+            out = [r for r in q if predicate(r)]
+            for r in out:
+                q.remove(r)
+        return out
 
 
 def _common_prefix_len(a, b) -> int:
@@ -151,6 +185,7 @@ class ContinuousBatchingScheduler:
         speculative: bool = True,
         prefix_min_tokens: int = 16,
         multi_step: int = 8,
+        deadlines: DeadlinePolicy | None = None,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
@@ -175,10 +210,23 @@ class ContinuousBatchingScheduler:
         the horizon (the dominant serving cost through a high-latency
         device link). Stops/EOS are applied retroactively; a cancel or a
         new admission takes effect at the next horizon boundary. 0 or 1
-        disables."""
+        disables.
+
+        ``deadlines`` (serving/deadlines.py): server-wide queue-wait
+        timeout and wall-clock generation budget; expired requests finish
+        with ``finish_reason="timeout"`` (queued ones without ever taking a
+        lane, active ones at the next loop iteration, freeing their lane).
+        Defaults to a policy with both limits disabled; per-request
+        overrides on ``Request`` apply either way.
+
+        The default queue is a :class:`~..serving.qos.QosQueue` (unbounded
+        unless the caller passes a capacity-bounded one): per-user
+        deficit-round-robin fair share and priority classes replace the
+        seed's bare FIFO."""
         self.engine = engine
         self.tokenizer = tokenizer
-        self.queue = queue_ or RequestQueue()
+        self.queue = queue_ or QosQueue()
+        self.deadlines = deadlines or DeadlinePolicy()
         self.eos_padding = eos_padding
         self.host_sampling = host_sampling
         self.speculative = speculative
@@ -190,27 +238,79 @@ class ContinuousBatchingScheduler:
         # reset when a new request claims the lane
         self._lane_kv: list[list[int]] = [[] for _ in range(engine.n_lanes)]
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._thread: threading.Thread | None = None
         self._chat_stops = TokenizerChatStops(tokenizer)
         self._prefill_rr = 0  # round-robin cursor over admitting lanes
+        # deadline enforcement counters (loop thread writes, /stats reads;
+        # int += is a single atomic-enough bump under the GIL)
+        self.queue_timeouts = 0
+        self.budget_timeouts = 0
+        self._last_sweep = 0.0
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
         self._stop.clear()  # restartable: a stop()ed scheduler can start again
+        self._draining.clear()
         self._thread = threading.Thread(target=self._run, name="batching-loop", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
-        """Clean shutdown — the reference's loop never terminates (defect (d))."""
+        """Clean shutdown — the reference's loop never terminates (defect (d)).
+        Raises if the loop thread outlives the join timeout (a hung device
+        dispatch): silently dropping the reference would leak a live thread
+        still mutating lanes and the KV cache."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=30)
+            if thread.is_alive():
+                raise RuntimeError(
+                    "batching loop failed to stop within 30s; thread is still "
+                    "alive (likely a hung device dispatch) and still owns the "
+                    "lanes — not dropping the reference"
+                )
             self._thread = None
 
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful shutdown (serving/drain.py): stop admitting — submit()
+        sheds with AdmissionRejected("draining") and /health flips to 503 —
+        let queued + active work finish or hit its deadline, then join the
+        loop thread. Returns True on a clean drain; on ``timeout`` the
+        remainder is force-cancelled (every future still resolves)."""
+        return drain_scheduler(self, timeout)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
     def submit(self, request: Request) -> Request:
-        self.queue.push(request)
+        if self._draining.is_set():
+            self._shed_draining()
+        if request.submitted_at is None:
+            request.submitted_at = time.monotonic()
+        try:
+            self.queue.push(request)
+        except AdmissionRejected:
+            request.submitted_at = None  # rejected: never entered the queue
+            raise
+        if self._draining.is_set():
+            # raced with drain(): the flag flipped during the push, so the
+            # loop may already have taken its exit snapshot without seeing
+            # this request. Pull it back out and shed; if it's already gone,
+            # the loop popped it and will serve it normally.
+            remove_if = getattr(self.queue, "remove_if", None)
+            if remove_if is not None and remove_if(lambda r: r is request):
+                request.submitted_at = None
+                self._shed_draining()
         return request
+
+    def _shed_draining(self) -> None:
+        note = getattr(self.queue, "note_rejection", None)
+        if note is not None:
+            note("draining")  # drain-shed load shows up in /stats too
+        raise AdmissionRejected("draining", retry_after_s=5.0)
 
     # -- internals ----------------------------------------------------------
 
@@ -224,12 +324,77 @@ class ContinuousBatchingScheduler:
             len(self._lanes),
         )
 
-    def _admit(self) -> None:
+    def qos_stats(self) -> dict:
+        """QoS counters for /stats: queue depth/wait/rejections (when the
+        queue tracks them) plus deadline enforcement and drain state."""
+        out = {
+            "draining": self.draining,
+            "queue_timeouts": self.queue_timeouts,
+            "budget_timeouts": self.budget_timeouts,
+        }
+        stats = getattr(self.queue, "stats", None)
+        if callable(stats):
+            out.update(stats())
+        return out
+
+    def _resolve_unadmitted(self, req: Request, reason: str) -> None:
+        """Finish a request that never claimed a lane (queue timeout, cancel
+        while queued): empty text, typed finish_reason."""
+        req.state = RequestState.DONE
+        req.finish_reason = reason
+        if not req.future.done():
+            req.future.set_result(req.generated_text)
+
+    def _shed_unadmitted(self, req: Request) -> None:
+        """Fail a request the drain window flushed before it ever claimed a
+        lane: the client got no service, so it must see a retryable 503
+        (AdmissionRejected, same shape submit() sheds with) — resolving it
+        as an empty 200 "cancelled" would read as the model's answer and
+        never be retried."""
+        req.state = RequestState.FAILED
+        req.finish_reason = "cancelled"
+        if not req.future.done():
+            req.future.set_exception(AdmissionRejected("draining", retry_after_s=5.0))
+
+    def _sweep_queue(self, now: float) -> None:
+        """Resolve queued requests that expired or were cancelled while
+        waiting — without this, a saturated server (no lane ever frees, so
+        nothing is ever popped) would hold its backlog open forever.
+        Throttled to ~20 Hz: the walk is O(queue depth) under the queue
+        lock, far too costly to contend with submit() on every decode
+        step, and 50ms of extra expiry/cancel latency is immaterial."""
+        remove_if = getattr(self.queue, "remove_if", None)
+        if remove_if is None:  # custom queue without removal: pop-time checks still apply
+            return
+        if self.queue.empty() or now - self._last_sweep < 0.05:
+            return
+        self._last_sweep = now
+        for req in remove_if(
+            lambda r: r._cancelled.is_set()
+            or queue_expired(r, self.deadlines, now)
+        ):
+            if req._cancelled.is_set():
+                self._resolve_unadmitted(req, "cancelled")
+            else:
+                self.queue_timeouts += 1
+                self._resolve_unadmitted(req, "timeout")
+
+    def _admit(self, wait_s: float = 0.0) -> None:
         free = self._free_lane_indices()
         while free:
-            req = self.queue.pop(timeout=0)
+            req = self.queue.pop(timeout=wait_s)
+            wait_s = 0.0  # only the first pop may park; the rest are polls
             if req is None:
                 return
+            now = time.monotonic()
+            if req._cancelled.is_set():
+                self._resolve_unadmitted(req, "cancelled")
+                continue
+            if queue_expired(req, self.deadlines, now):
+                self.queue_timeouts += 1
+                self._resolve_unadmitted(req, "timeout")
+                continue
+            req.admitted_at = now
             lane_idx = free.pop(0)
             try:
                 self._start_request(lane_idx, req)
@@ -277,8 +442,9 @@ class ContinuousBatchingScheduler:
             if best_lcp >= self.prefix_min_tokens:
                 self.engine.copy_lane(best_lane, lane_idx)
                 start = best_lcp
-                self.engine.stats.prefix_hits += 1
-                self.engine.stats.prefix_tokens_saved += best_lcp
+                with self.engine.stats.lock:
+                    self.engine.stats.prefix_hits += 1
+                    self.engine.stats.prefix_tokens_saved += best_lcp
         self._lane_kv[lane_idx] = list(tokens[:start])
 
         lane = self._lanes[lane_idx]
@@ -427,16 +593,31 @@ class ContinuousBatchingScheduler:
         n_lanes = self.engine.n_lanes
         cfg = self.engine.config
         while not self._stop.is_set():
-            self._admit()
+            idle = all(l.request is None for l in self._lanes)
+            # when every lane is free, park on the queue's condition variable
+            # instead of spinning pop(timeout=0)+sleep — an idle server burns
+            # no core, and a push wakes the loop immediately
+            self._admit(wait_s=0.25 if idle else 0.0)
+            now = time.monotonic()
+            self._sweep_queue(now)
+            if (
+                self._draining.is_set()
+                and self.queue.empty()
+                and all(l.request is None for l in self._lanes)
+            ):
+                break  # graceful drain: all work done, submit() is shedding
             occupied = [(i, l) for i, l in enumerate(self._lanes) if l.request is not None]
             if not occupied:
-                self._stop.wait(0.05)  # _admit is the only queue consumer (FIFO)
-                continue
+                continue  # _admit already waited on the queue
 
-            # drop cancelled requests before spending a step on them
+            # drop cancelled / budget-expired requests before spending a
+            # step on them (expiry frees the lane for the next admission)
             for i, lane in occupied:
                 if lane.request._cancelled.is_set():
                     self._finish(i, lane.request, reason="cancelled")
+                elif budget_expired(lane.request, self.deadlines, now):
+                    self.budget_timeouts += 1
+                    self._finish(i, lane.request, reason="timeout")
 
             # at most ONE prompt bucket per iteration: decoding lanes below
             # stall no longer than one bucket while admissions stream in
@@ -540,19 +721,21 @@ class ContinuousBatchingScheduler:
                     # and draft-less lanes ride the same batched verify call
                     # but always emit 1, which would dilute the metric
                     drafted = int(draft_len[i]) > 0
-                    if drafted:
-                        self.engine.stats.spec_lane_steps += 1
                     cnt = int(n_emit[i])
                     seq = [lane.next_token] + [
                         int(t) for t in emitted[i, : cnt - 1]
                     ]
                     alive = True
+                    n_fed = 0
                     for t in seq:
-                        if drafted:
-                            self.engine.stats.spec_emitted += 1  # consumed
+                        n_fed += 1  # consumed (finishing token included)
                         if not self._consume(i, lane, t):
                             alive = False
                             break
+                    if drafted:
+                        with self.engine.stats.lock:
+                            self.engine.stats.spec_lane_steps += 1
+                            self.engine.stats.spec_emitted += n_fed
                     if not alive:
                         continue
                     nxt_greedy = int(emitted[i, cnt - 1])
@@ -589,7 +772,16 @@ class ContinuousBatchingScheduler:
         for i, lane in enumerate(self._lanes):
             if lane.request is not None:
                 self._finish(i, lane.request, reason="cancelled")
+        draining = self._draining.is_set()
         for req in self.queue.drain():
-            req.state = RequestState.FAILED
-            if not req.future.done():
-                req.future.set_exception(RuntimeError("scheduler stopped"))
+            if draining:
+                # graceful drain: a submit() that passed the pre-push shed
+                # check can land its push after this loop's exit snapshot;
+                # shed it like submit() would (503 + Retry-After) —
+                # "scheduler stopped" would surface as a 500 in the middle
+                # of a rolling restart
+                self._shed_unadmitted(req)
+            else:
+                req.state = RequestState.FAILED
+                if not req.future.done():
+                    req.future.set_exception(RuntimeError("scheduler stopped"))

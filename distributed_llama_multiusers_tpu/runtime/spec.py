@@ -122,7 +122,8 @@ class SpecStream:
                 self.drafter.append(cur)
             stats = getattr(self.engine, "stats", None)
             if stats is not None and self._pending_spec:
-                stats.spec_emitted += 1  # lookahead token consumed NOW
+                with stats.lock:
+                    stats.spec_emitted += 1  # lookahead token consumed NOW
             return self.pending.pop(0), False
         draft: list[int] = []
         if self.drafter is not None:
@@ -148,8 +149,9 @@ class SpecStream:
             # never count if a turn ends and discards them)
             stats = getattr(self.engine, "stats", None)
             if stats is not None:
-                stats.spec_lane_steps += 1
-                stats.spec_emitted += 1  # seq[0], consumed now
+                with stats.lock:
+                    stats.spec_lane_steps += 1
+                    stats.spec_emitted += 1  # seq[0], consumed now
             return seq[0], True
         if self.multi_h > 1:
             # no draft: chain a horizon of plain decode steps instead of

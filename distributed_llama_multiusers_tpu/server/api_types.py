@@ -9,6 +9,8 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+from ..serving.qos import Priority
+
 
 @dataclass
 class ChatMessage:
@@ -53,6 +55,11 @@ class InferenceParams:
     seed: int | None = None
     stop: list[str] = field(default_factory=list)
     stream: bool = False
+    # QoS identity (serving/qos.py): "user" is the OpenAI API's own
+    # end-user field and keys the per-user fair share; "priority" is
+    # "high" | "normal" | "low" (or the int class value)
+    user: str = ""
+    priority: int = Priority.NORMAL
 
     @staticmethod
     def from_body(body: dict) -> "InferenceParams":
@@ -71,6 +78,10 @@ class InferenceParams:
         elif isinstance(stop, list):
             p.stop = [str(s) for s in stop]
         p.stream = bool(body.get("stream", False))
+        if body.get("user") is not None:
+            p.user = str(body.get("user", ""))
+        if body.get("priority") is not None:
+            p.priority = Priority.parse(body["priority"])  # ValueError -> 400
         return p
 
 

@@ -22,6 +22,7 @@ import queue
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..runtime.scheduler import Request
+from ..serving import AdmissionRejected
 from ..tokenizer import ChatItem, TemplateType, chat_generator_for
 from . import api_types
 
@@ -48,6 +49,8 @@ class ApiServer:
             topp=params.top_p,
             seed=params.seed,
             stop=params.stop,
+            user_id=params.user,
+            priority=params.priority,
             on_delta=(deltas.put if streaming else None),
         )
         return req, deltas
@@ -85,7 +88,8 @@ class ApiServer:
         )
 
     def _run_request(self, req, deltas, send_chunk, chunk_fn, response_fn) -> dict:
-        self.scheduler.submit(req)
+        if req.submitted_at is None:  # streaming pre-submits before headers
+            self.scheduler.submit(req)
 
         if send_chunk:
             req.future.add_done_callback(lambda _f: deltas.put(None))
@@ -95,7 +99,13 @@ class ApiServer:
                     if delta is None:
                         break
                     send_chunk(chunk_fn(self.model_name, req.id, delta, False))
-                req.future.result()  # re-raise failures
+                try:
+                    req.future.result()  # re-raise failures
+                except AdmissionRejected:
+                    # drain flushed this queued request after the SSE headers
+                    # were committed — too late for a 503 status line, so end
+                    # the stream with a terminal "cancelled" chunk instead
+                    req.finish_reason = "cancelled"
                 send_chunk(
                     chunk_fn(
                         self.model_name, req.id, None, True, req.finish_reason or "stop"
@@ -119,36 +129,41 @@ class ApiServer:
 
     def handle_stats(self) -> dict:
         """Serving metrics (beyond reference parity — SURVEY §5.5 notes it
-        has no metrics endpoint): engine counters plus scheduler occupancy."""
+        has no metrics endpoint): engine counters plus scheduler occupancy
+        and QoS state. Engine counters come from ONE locked snapshot, not
+        field-by-field reads racing the batching thread."""
         sched = self.scheduler
-        stats = sched.engine.stats
+        stats = sched.engine.stats.snapshot()
         busy, total = sched.occupancy()
-        spec_steps = stats.spec_steps
-        return {
-            "prefill_tokens": stats.prefill_tokens,
-            "prefill_s": round(stats.prefill_s, 3),
-            "decode_steps": stats.decode_steps,
-            "decode_s": round(stats.decode_s, 3),
-            "host_bytes_in": stats.host_bytes_in,
-            "spec_steps": spec_steps,
-            "spec_emitted": stats.spec_emitted,
-            "spec_lane_steps": stats.spec_lane_steps,
+        out = {
+            "prefill_tokens": stats["prefill_tokens"],
+            "prefill_s": round(stats["prefill_s"], 3),
+            "decode_steps": stats["decode_steps"],
+            "decode_s": round(stats["decode_s"], 3),
+            "host_bytes_in": stats["host_bytes_in"],
+            "spec_steps": stats["spec_steps"],
+            "spec_emitted": stats["spec_emitted"],
+            "spec_lane_steps": stats["spec_lane_steps"],
             # acceptance per (DRAFTED lane, verify-step): 1.0 = no draft
             # accepted, K+1 = full acceptance. Sampled/draft-less lanes ride
             # the same batched call but are excluded from both counters.
             "spec_tokens_per_lane_step": (
-                round(stats.spec_emitted / stats.spec_lane_steps, 3)
-                if stats.spec_lane_steps else None
+                round(stats["spec_emitted"] / stats["spec_lane_steps"], 3)
+                if stats["spec_lane_steps"] else None
             ),
-            "sync_bytes_per_decode": stats.sync_bytes_per_decode,
+            "sync_bytes_per_decode": stats["sync_bytes_per_decode"],
             # multi-step horizons taken (each = several decode steps in one
             # device dispatch; decode_steps counts the chained steps)
-            "multi_dispatches": stats.multi_dispatches,
-            "prefix_hits": stats.prefix_hits,
-            "prefix_tokens_saved": stats.prefix_tokens_saved,
+            "multi_dispatches": stats["multi_dispatches"],
+            "prefix_hits": stats["prefix_hits"],
+            "prefix_tokens_saved": stats["prefix_tokens_saved"],
             "lanes_total": total,
             "lanes_busy": busy,
         }
+        qos = getattr(sched, "qos_stats", None)
+        if callable(qos):  # queue depth/wait/rejections, timeouts, drain
+            out.update(qos())
+        return out
 
     # -- plumbing -----------------------------------------------------------
 
@@ -166,14 +181,25 @@ class ApiServer:
                 self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
                 self.send_header("Access-Control-Allow-Headers", "Content-Type, Authorization")
 
-            def _json(self, code: int, payload: dict):
+            def _json(self, code: int, payload: dict, headers: dict | None = None):
                 data = json.dumps(payload).encode()
                 self.send_response(code)
                 self._cors()
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _reject(self, e: AdmissionRejected):
+                # load shed: 429 (queue full) / 503 (draining), with a
+                # Retry-After hint so well-behaved clients back off
+                self._json(
+                    e.http_status,
+                    {"error": str(e), "reason": e.reason},
+                    headers={"Retry-After": str(max(1, round(e.retry_after_s)))},
+                )
 
             def do_OPTIONS(self):  # CORS preflight (dllama-api.cpp:228-236)
                 self.send_response(204)
@@ -187,7 +213,16 @@ class ApiServer:
                 elif self.path == "/stats":
                     self._json(200, api.handle_stats())
                 elif self.path in ("/", "/health"):
-                    self._json(200, {"status": "ok", "model": api.model_name})
+                    # readiness: flips to 503 during drain so load balancers
+                    # stop routing here while in-flight work finishes
+                    if bool(getattr(api.scheduler, "draining", False)):
+                        self._json(
+                            503,
+                            {"status": "draining", "model": api.model_name},
+                            headers={"Retry-After": "5"},
+                        )
+                    else:
+                        self._json(200, {"status": "ok", "model": api.model_name})
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -213,15 +248,24 @@ class ApiServer:
                     return
                 try:
                     if body.get("stream"):
-                        # validate BEFORE committing SSE headers so bad input
-                        # still gets a proper 400
+                        # validate AND submit BEFORE committing SSE headers so
+                        # bad input still gets a proper 400 and a shed request
+                        # (queue full / draining) a proper 429/503
                         prepared = build_fn(body, streaming=True)
-                        self.send_response(200)
-                        self._cors()
-                        self.send_header("Content-Type", "text/event-stream")
-                        self.send_header("Cache-Control", "no-cache")
-                        self.send_header("Connection", "close")
-                        self.end_headers()
+                        api.scheduler.submit(prepared[0])
+                        try:
+                            self.send_response(200)
+                            self._cors()
+                            self.send_header("Content-Type", "text/event-stream")
+                            self.send_header("Cache-Control", "no-cache")
+                            self.send_header("Connection", "close")
+                            self.end_headers()
+                        except BaseException:
+                            # client vanished between submit and the header
+                            # commit: no pump will ever run, so cancel or the
+                            # lane generates max_tokens into an orphaned queue
+                            prepared[0].cancel()
+                            raise
 
                         def send_chunk(payload: dict):
                             self.wfile.write(b"data: " + json.dumps(payload).encode() + b"\n\n")
@@ -237,6 +281,8 @@ class ApiServer:
                             self.wfile.write(b"data: [DONE]\n\n")
                     else:
                         self._json(200, handle_fn(body))
+                except AdmissionRejected as e:  # shed before any headers
+                    self._reject(e)
                 except ValueError as e:
                     self._json(400, {"error": str(e)})
                 except Exception as e:  # generation failure

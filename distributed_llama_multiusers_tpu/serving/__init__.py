@@ -1,0 +1,18 @@
+"""Serving QoS subsystem: bounded admission, deadlines, graceful drain.
+
+The substrate between the HTTP layer (server/http.py) and the
+continuous-batching loop (runtime/scheduler.py): qos.py owns who gets in
+and in what order, deadlines.py owns how long anything may wait or run,
+drain.py owns how the whole thing shuts down without dropping clients.
+Imports nothing from runtime/ or server/ — it is a leaf both depend on.
+"""
+
+from .deadlines import (
+    DeadlinePolicy,
+    budget_expired,
+    budget_for,
+    queue_expired,
+    queue_timeout_for,
+)
+from .drain import drain_scheduler
+from .qos import AdmissionRejected, Priority, QosQueue
