@@ -1,0 +1,77 @@
+"""dlint: project-invariant static analysis for the serving path.
+
+PR 1 made the serving path heavily concurrent; the invariants that keep
+it correct ("counters only under ``stats.lock``", "durations use
+``time.monotonic()``", "one host transfer per decode step", "axis names
+come from ``parallel/mesh.py``") were enforced only by comments and
+reviewer memory. This package machine-checks them — the Python/JAX
+analogue of the reference repo's sanitizer CI for C++ (SURVEY.md §5.2,
+mirrored by ``make sanitize``).
+
+Five checks (docs/LINT.md has the full contract and waiver policy):
+
+- ``guarded-by``   — lock discipline for declared shared attributes
+- ``host-sync``    — explicit, waived device->host transfers in decode
+- ``clock``        — no wall clock for durations/deadlines/seeds
+- ``condvar``      — predicate loops, no busy-polls, joined threads
+- ``sharding-axis``— PartitionSpec/collective axes declared by the mesh
+
+Usage::
+
+    python -m distributed_llama_multiusers_tpu.analysis   # or `make lint`
+    python -m distributed_llama_multiusers_tpu.analysis path/to/file.py
+
+Library::
+
+    from distributed_llama_multiusers_tpu.analysis import analyze_paths
+    findings = analyze_paths()          # whole package, shipped baseline
+
+Pure stdlib (ast + tokenize): importable and runnable on CPython >= 3.10
+with no jax/numpy present.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .core import (
+    Analyzer,
+    Checker,
+    Finding,
+    Project,
+    SourceFile,
+    load_baseline,
+    write_baseline,
+)
+from .registry import ALL_CHECKERS, default_checkers
+
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent
+REPO_ROOT = PACKAGE_ROOT.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.txt"
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Analyzer",
+    "Checker",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "PACKAGE_ROOT",
+    "Project",
+    "SourceFile",
+    "analyze_paths",
+    "default_checkers",
+    "load_baseline",
+    "write_baseline",
+]
+
+
+def analyze_paths(paths=None, baseline_path=DEFAULT_BASELINE) -> list[Finding]:
+    """Run every checker over ``paths`` (default: the whole package) and
+    return surviving findings (waivers and baseline applied).
+    ``baseline_path=None`` disables the baseline."""
+    analyzer = Analyzer(default_checkers())
+    return analyzer.run(
+        [PACKAGE_ROOT] if paths is None else paths,
+        baseline=load_baseline(baseline_path),
+        root=REPO_ROOT,
+    )

@@ -1,0 +1,92 @@
+"""clock: wall-clock reads are forbidden for durations, deadlines, seeds.
+
+Every latency measurement, deadline, and retry hint in the serving path
+runs on ``time.monotonic()`` / ``time.perf_counter()``; sampler seeds come
+from OS entropy (``utils/seeds.py``). ``time.time()`` jumps under NTP
+slew/step and DST-adjacent clock math, which turns queue timeouts, drain
+windows, and Retry-After hints into lies — and two requests landing in
+the same wall-clock microsecond used to get identical sampler seeds.
+
+The only legitimate wall-clock use is an absolute timestamp leaving the
+process (the OpenAI-compatible ``created`` fields); those sites carry
+``# dlint: ok[clock]`` waivers. The check is import-aware, package-wide:
+it flags dotted references through module aliases (``import time as t``
+→ ``t.time``), naive-datetime "now" constructors through class imports
+(``from datetime import datetime as dt`` → ``dt.now()``), and the
+``from time import time`` import itself (the bound name has no
+non-wall-clock use, so the import line is the finding).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Finding, Project, SourceFile
+
+# members banned on the resolved dotted path
+WALL_CLOCK_ATTRS = {
+    "time.time",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+}
+# `from <module> import <name>`: bindings banned at the import line
+BANNED_FROM_IMPORTS = {("time", "time")}
+
+_MESSAGE = (
+    "is wall clock: use time.monotonic()/perf_counter() for durations and "
+    "deadlines, utils.seeds.fresh_seed() for seeds; waive only absolute "
+    "timestamps that leave the process (API 'created')"
+)
+
+
+class ClockChecker(Checker):
+    name = "clock"
+    description = (
+        "time.time()/datetime.now() are wall clock — durations, deadlines "
+        "and seeds must use time.monotonic()/perf_counter()/OS entropy"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        # name -> canonical dotted prefix it stands for:
+        #   import time            -> {"time": "time"}
+        #   import time as t       -> {"t": "time"}
+        #   from datetime import datetime as dt -> {"dt": "datetime.datetime"}
+        aliases: dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if (node.module, a.name) in BANNED_FROM_IMPORTS:
+                        yield Finding(
+                            self.name, sf.display, node.lineno,
+                            f"'from {node.module} import {a.name}' binds the "
+                            f"wall clock directly; '{node.module}.{a.name}' "
+                            + _MESSAGE,
+                        )
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            dotted = self._resolve(node, aliases)
+            if dotted in WALL_CLOCK_ATTRS:
+                yield Finding(
+                    self.name, sf.display, node.lineno,
+                    f"'{ast.unparse(node)}' " + _MESSAGE,
+                )
+
+    @staticmethod
+    def _resolve(node: ast.Attribute, aliases: dict[str, str]) -> str | None:
+        """Dotted path with the root name resolved through the import
+        aliases; None when the chain doesn't start at a plain Name."""
+        parts = [node.attr]
+        cur = node.value
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = aliases.get(cur.id, cur.id)
+        return ".".join([root, *reversed(parts)])

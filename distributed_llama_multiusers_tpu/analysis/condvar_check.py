@@ -1,0 +1,182 @@
+"""condvar: condition/event/thread hygiene in the concurrent serving path.
+
+Three classic latent-bug shapes, all of which have bitten continuous-
+batching servers:
+
+1. **Condition.wait outside a predicate loop** — condition variables wake
+   spuriously and race with other waiters; a bare ``cv.wait()`` that is
+   not re-checking its predicate in a ``while`` (or using ``wait_for``)
+   proceeds on stale state.
+2. **Event.wait with a tiny timeout** — ``ev.wait(0.001)`` in a loop is a
+   busy-poll dressed as a wait: it burns a core and adds latency jitter.
+   Park on a real condition (the queue's) or use a meaningful timeout.
+3. **daemon threads with no join** — ``Thread(daemon=True)`` started by a
+   class/function whose scope never ``join``s anything means the stop
+   path abandons a live thread that still mutates shared state (the seed
+   repo's loop-thread leak, SURVEY.md §2.3 defect (d)).
+
+Attributes/locals are classified by their construction site
+(``threading.Condition(...)`` / ``threading.Event(...)`` assignments,
+including dataclass ``field(default_factory=threading.Event)``), matched
+by name within the file — no type inference, so keep constructor
+assignments and use sites in the same module (they naturally are).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Checker, Finding, Project, SourceFile, walk_with_ancestors
+
+COND_RE = re.compile(r"\bthreading\.Condition\b|\bCondition\(")
+EVENT_RE = re.compile(r"\bthreading\.Event\b|\bEvent\(")
+BUSY_POLL_S = 0.05  # Event.wait timeouts under this are busy-polls
+
+
+def _target_names(tgt: ast.AST) -> list[str]:
+    """Bindable name of an assignment target: `x` -> x, `self._stop` ->
+    _stop (the attribute name is what use sites spell)."""
+    if isinstance(tgt, ast.Name):
+        return [tgt.id]
+    if isinstance(tgt, ast.Attribute):
+        return [tgt.attr]
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out = []
+        for e in tgt.elts:
+            out.extend(_target_names(e))
+        return out
+    return []
+
+
+class CondvarChecker(Checker):
+    name = "condvar"
+    description = (
+        "Condition.wait needs a predicate loop; Event.wait(<0.05s) is a "
+        "busy-poll; daemon threads need a join on the stop path"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        conds, events = self._classify(sf.tree)
+        for node, ancestors in walk_with_ancestors(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "wait":
+                holder = (
+                    func.value.attr
+                    if isinstance(func.value, ast.Attribute)
+                    else func.value.id if isinstance(func.value, ast.Name) else None
+                )
+                if holder in conds:
+                    if not any(isinstance(a, ast.While) for a in ancestors):
+                        yield Finding(
+                            self.name, sf.display, node.lineno,
+                            f"Condition.wait on '{ast.unparse(func.value)}' "
+                            "without an enclosing predicate loop — use "
+                            "'while <pred>: cv.wait(...)' or cv.wait_for()",
+                        )
+                elif holder in events:
+                    timeout = self._const_timeout(node)
+                    if timeout is not None and timeout < BUSY_POLL_S:
+                        yield Finding(
+                            self.name, sf.display, node.lineno,
+                            f"busy-poll: Event.wait({timeout:g}) on "
+                            f"'{ast.unparse(func.value)}' — park on a "
+                            "condition variable or use a real timeout",
+                        )
+            elif self._is_daemon_thread(node):
+                scope = self._join_scope(ancestors, sf.tree)
+                if not self._has_join(scope):
+                    where = getattr(scope, "name", "module scope")
+                    yield Finding(
+                        self.name, sf.display, node.lineno,
+                        "daemon Thread started with no .join() anywhere in "
+                        f"'{where}' — the stop path abandons a live thread "
+                        "still mutating shared state",
+                    )
+
+    # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _classify(tree: ast.AST) -> tuple[set[str], set[str]]:
+        conds: set[str] = set()
+        events: set[str] = set()
+        for node in ast.walk(tree):
+            targets: list[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            rhs = ast.unparse(value)
+            bucket = None
+            if COND_RE.search(rhs):
+                bucket = conds
+            elif EVENT_RE.search(rhs):
+                bucket = events
+            if bucket is None:
+                continue
+            for tgt in targets:
+                bucket.update(_target_names(tgt))
+        return conds, events
+
+    @staticmethod
+    def _const_timeout(node: ast.Call) -> float | None:
+        arg = None
+        if node.args:
+            arg = node.args[0]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "timeout":
+                    arg = kw.value
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+            return float(arg.value)
+        return None
+
+    @staticmethod
+    def _is_daemon_thread(node: ast.Call) -> bool:
+        func = node.func
+        callee = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if callee != "Thread":
+            return False
+        return any(
+            kw.arg == "daemon"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in node.keywords
+        )
+
+    @staticmethod
+    def _join_scope(ancestors, tree: ast.AST) -> ast.AST:
+        """Where a matching join must live: the enclosing class if any
+        (create in start(), join in stop()), else the enclosing function,
+        else the module."""
+        for a in reversed(ancestors):
+            if isinstance(a, ast.ClassDef):
+                return a
+        for a in reversed(ancestors):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return tree
+
+    @staticmethod
+    def _has_join(scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and not (
+                    # exclude str.join / os.path.join — receivers are a
+                    # string constant or a *path attribute chain
+                    isinstance(node.func.value, ast.Constant)
+                    or ast.unparse(node.func.value).endswith("path")
+                )
+            ):
+                return True
+        return False

@@ -1,0 +1,333 @@
+"""dlint core: checker framework, waivers, baseline.
+
+The analyzer is a plain-AST pass over the package (stdlib only — no jax,
+no numpy: `make lint` must run anywhere CPython >= 3.10 runs, before any
+heavyweight import). Each checker gets two phases:
+
+- ``collect(sf, project)`` — gather cross-file facts (guarded-by
+  declarations, declared mesh axes) into the shared :class:`Project`;
+- ``check(sf, project)`` — yield :class:`Finding`s for one file.
+
+Findings are suppressed by
+
+- **inline waivers** — ``# dlint: ok[check-name] reason`` on the finding's
+  line (or on a standalone comment line directly above it). The reason is
+  mandatory: a bare waiver is itself a finding (check ``waiver``), so every
+  silenced invariant carries its justification in the tree. ``ok[*]``
+  waives all checks on that line.
+- the **baseline file** — one ``check<TAB>path<TAB>message`` key per line
+  for pre-existing findings accepted at adoption time. New findings never
+  match old keys, so regressions stay loud while the backlog burns down.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+WAIVER_RE = re.compile(r"#\s*dlint:\s*ok\[([^\]]*)\]\s*(.*?)\s*$")
+GUARD_DECL_NAME = "_dlint_guarded_by"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit, anchored to file:line with a line-free message
+    (messages are the stable part of the baseline key; line numbers churn
+    with every edit, so they are display-only)."""
+
+    check: str
+    path: str
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.check}\t{self.path}\t{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Waiver:
+    line: int
+    checks: tuple[str, ...]  # check names, or ("*",)
+    reason: str
+    standalone: bool  # comment-only line: also covers the next line
+
+    def covers(self, check: str) -> bool:
+        return "*" in self.checks or check in self.checks
+
+
+@dataclass
+class SourceFile:
+    path: Path  # absolute
+    display: str  # stable-ish path used in findings/baseline keys
+    text: str
+    tree: ast.Module
+    waivers: dict[int, Waiver] = field(default_factory=dict)
+
+    def endswith(self, *suffixes: str) -> bool:
+        """Posix-path suffix test, independent of cwd (fixtures live in
+        tmp dirs; the real tree under the repo root)."""
+        p = self.path.as_posix()
+        return any(p.endswith(s) for s in suffixes)
+
+
+class Project:
+    """Cross-file facts collected before checking starts."""
+
+    def __init__(self):
+        # attr name -> (frozenset of acceptable lock attr names, decl site)
+        self.guarded: dict[str, tuple[frozenset[str], str]] = {}
+        # declared mesh axis names (from `AXES = (...)` in parallel/mesh.py)
+        self.axes: set[str] = set()
+        self.axes_src: str | None = None
+        # findings raised during collect (malformed declarations)
+        self.collect_findings: list[Finding] = []
+
+
+class Checker:
+    """Base class; subclasses set ``name``/``description`` and override
+    ``check`` (and ``collect`` when they need cross-file state)."""
+
+    name = "base"
+    description = ""
+
+    def collect(self, sf: SourceFile, project: Project) -> None:
+        return None
+
+    def check(self, sf: SourceFile, project: Project) -> Iterator[Finding]:
+        return iter(())
+
+
+def walk_with_ancestors(tree: ast.AST) -> Iterator[tuple[ast.AST, tuple[ast.AST, ...]]]:
+    """Yield (node, ancestors) over the whole tree, outermost ancestor
+    first — checkers need lexical context (enclosing with/while/function)
+    that ast.walk throws away."""
+    stack: list[ast.AST] = []
+
+    def rec(node: ast.AST) -> Iterator[tuple[ast.AST, tuple[ast.AST, ...]]]:
+        yield node, tuple(stack)
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            yield from rec(child)
+        stack.pop()
+
+    yield from rec(tree)
+
+
+def nearest(ancestors: Iterable[ast.AST], *types) -> ast.AST | None:
+    """Innermost ancestor of one of ``types`` (ancestors are outermost
+    first, so scan from the end)."""
+    for node in reversed(list(ancestors)):
+        if isinstance(node, types):
+            return node
+    return None
+
+
+def root_name(node: ast.AST) -> str | None:
+    """Leftmost Name of an attribute/subscript chain (`a.b[0].c` -> `a`)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def last_component(func: ast.AST) -> str | None:
+    """Final name of a callee (`self.engine.decode` -> `decode`)."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def parse_waivers(
+    text: str, valid_checks: set[str], display: str
+) -> tuple[dict[int, Waiver], list[Finding]]:
+    """Extract ``# dlint: ok[...]`` comments with tokenize (comments only —
+    a waiver-shaped string literal must not silence anything). Returns the
+    per-line waiver map plus syntax findings: empty check list, unknown
+    check name, or a missing reason. Waiver-syntax findings are not
+    themselves waivable."""
+    waivers: dict[int, Waiver] = {}
+    findings: list[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return waivers, findings  # the ast parse reports the real error
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = WAIVER_RE.search(tok.string)
+        if m is None:
+            if re.search(r"#\s*dlint\s*:", tok.string) and "ok[" not in tok.string:
+                findings.append(Finding(
+                    "waiver", display, tok.start[0],
+                    f"unrecognized dlint comment {tok.string.strip()!r} "
+                    "(expected '# dlint: ok[check-name] reason')",
+                ))
+            continue
+        line = tok.start[0]
+        checks = tuple(c.strip() for c in m.group(1).split(",") if c.strip())
+        reason = m.group(2).strip()
+        if not checks:
+            findings.append(Finding(
+                "waiver", display, line,
+                "waiver with an empty check list (use ok[check-name] or ok[*])",
+            ))
+            continue
+        unknown = [c for c in checks if c != "*" and c not in valid_checks]
+        if unknown:
+            findings.append(Finding(
+                "waiver", display, line,
+                f"waiver names unknown check(s) {unknown} "
+                f"(known: {sorted(valid_checks)})",
+            ))
+            continue
+        if not reason:
+            findings.append(Finding(
+                "waiver", display, line,
+                f"bare waiver ok[{','.join(checks)}] without a reason — every "
+                "waiver must say WHY the invariant is intentionally broken",
+            ))
+            continue
+        standalone = text.splitlines()[line - 1][: tok.start[1]].strip() == ""
+        waivers[line] = Waiver(line, checks, reason, standalone)
+    return waivers, findings
+
+
+def waived(sf: SourceFile, finding: Finding) -> bool:
+    w = sf.waivers.get(finding.line)
+    if w is not None and w.covers(finding.check):
+        return True
+    prev = sf.waivers.get(finding.line - 1)
+    return prev is not None and prev.standalone and prev.covers(finding.check)
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: Path | str | None) -> set[str]:
+    if path is None:
+        return set()
+    p = Path(path)
+    if not p.exists():
+        return set()
+    out = set()
+    for line in p.read_text(encoding="utf-8").splitlines():
+        line = line.rstrip("\n")
+        if not line.strip() or line.lstrip().startswith("#"):
+            continue
+        out.add(line)
+    return out
+
+
+def write_baseline(path: Path | str, findings: Iterable[Finding]) -> None:
+    keys = sorted({f.key for f in findings})
+    header = (
+        "# dlint baseline: pre-existing findings accepted at adoption time.\n"
+        "# One 'check<TAB>path<TAB>message' key per line; regenerate with\n"
+        "# `python -m distributed_llama_multiusers_tpu.analysis --write-baseline`.\n"
+        "# Prefer FIXING or waiving (with a reason) over baselining — see\n"
+        "# docs/LINT.md for the policy.\n"
+    )
+    Path(path).write_text(header + "".join(k + "\n" for k in keys), encoding="utf-8")
+
+
+# -- analyzer ----------------------------------------------------------------
+
+
+def iter_py_files(paths: Iterable[Path | str]) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(q for q in p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    seen: set[Path] = set()
+    uniq = []
+    for p in out:
+        r = p.resolve()
+        if r not in seen:
+            seen.add(r)
+            uniq.append(p)
+    return uniq
+
+
+def _display_path(p: Path, root: Path | None) -> str:
+    try:
+        base = root if root is not None else Path.cwd()
+        return p.resolve().relative_to(base.resolve()).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+class Analyzer:
+    def __init__(self, checkers: list[Checker]):
+        self.checkers = checkers
+        self.valid_checks = {c.name for c in checkers} | {"waiver", "parse"}
+
+    def run(
+        self,
+        paths: Iterable[Path | str],
+        baseline: set[str] | None = None,
+        root: Path | None = None,
+    ) -> list[Finding]:
+        baseline = baseline or set()
+        files: list[SourceFile] = []
+        findings: list[Finding] = []
+        for p in iter_py_files(paths):
+            display = _display_path(p, root)
+            try:
+                text = p.read_text(encoding="utf-8")
+                tree = ast.parse(text, filename=str(p))
+            except (OSError, SyntaxError, ValueError) as e:
+                findings.append(Finding(
+                    "parse", display, getattr(e, "lineno", 0) or 0,
+                    f"cannot analyze: {type(e).__name__}: {e}",
+                ))
+                continue
+            sf = SourceFile(path=p, display=display, text=text, tree=tree)
+            sf.waivers, wf = parse_waivers(text, self.valid_checks, display)
+            findings.extend(wf)  # waiver-syntax findings: never waivable
+            files.append(sf)
+
+        project = Project()
+        for checker in self.checkers:
+            for sf in files:
+                checker.collect(sf, project)
+        findings.extend(project.collect_findings)
+
+        sf_by_display = {sf.display: sf for sf in files}
+        for checker in self.checkers:
+            for sf in files:
+                for f in checker.check(sf, project):
+                    findings.append(f)
+
+        out = []
+        seen: set[tuple] = set()  # dedup (nested defs are walked twice)
+        for f in findings:
+            k = (f.check, f.path, f.line, f.message)
+            if k in seen:
+                continue
+            seen.add(k)
+            if f.check in ("waiver", "parse"):
+                out.append(f)  # hygiene findings are not waivable/baselinable
+                continue
+            sf = sf_by_display.get(f.path)
+            if sf is not None and waived(sf, f):
+                continue
+            if f.key in baseline:
+                continue
+            out.append(f)
+        out.sort(key=lambda f: (f.path, f.line, f.check, f.message))
+        return out

@@ -1,0 +1,141 @@
+"""guarded-by: lock discipline for declared shared attributes.
+
+A class declares which of its attributes a lock guards with a plain
+(non-annotated, so dataclasses ignore it) class attribute:
+
+    class EngineStats:
+        _dlint_guarded_by = {
+            ("lock",): ("decode_steps", "host_bytes_in", ...),
+        }
+
+Keys are tuples of acceptable lock attribute names (a Condition built over
+the lock counts — holding either is holding the lock); values are the
+guarded attribute names. Enforcement is lexical and name-based (no type
+inference): any ``BASE.attr`` access where ``attr`` is declared guarded
+must sit inside ``with BASE.<lock>:`` for one of the acceptable locks on
+the *same* base expression — so ``self.engine.stats.prefix_hits`` needs
+``with self.engine.stats.lock:``, and a lock held on a different object
+does not count. Exemptions, matching classic @GuardedBy semantics:
+
+- ``__init__`` bodies (the object is not shared yet);
+- methods named ``*_locked`` (the caller holds the lock by contract);
+- waivers, for contractually-racy advisory reads.
+
+Name-based matching means guarded attribute names should be distinctive;
+the declared sets here (EngineStats counters, QosQueue internals) are
+unique within the package, which is the analyzer's default scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    GUARD_DECL_NAME,
+    Checker,
+    Finding,
+    Project,
+    SourceFile,
+    nearest,
+    walk_with_ancestors,
+)
+
+
+class GuardedByChecker(Checker):
+    name = "guarded-by"
+    description = (
+        "attributes declared in _dlint_guarded_by may only be touched "
+        "inside `with <base>.<lock>:` (or __init__ / *_locked methods)"
+    )
+
+    # -- collect: find declarations anywhere in the analyzed set ------------
+
+    def collect(self, sf: SourceFile, project: Project) -> None:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if not (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == GUARD_DECL_NAME
+                ):
+                    continue
+                try:
+                    decl = ast.literal_eval(stmt.value)
+                    if not isinstance(decl, dict):
+                        raise ValueError("declaration must be a dict literal")
+                    items = []
+                    for locks, attrs in decl.items():
+                        locks_t = (locks,) if isinstance(locks, str) else tuple(locks)
+                        attrs_t = (attrs,) if isinstance(attrs, str) else tuple(attrs)
+                        if not locks_t or not all(isinstance(x, str) for x in locks_t):
+                            raise ValueError("lock names must be strings")
+                        if not all(isinstance(x, str) for x in attrs_t):
+                            raise ValueError("attribute names must be strings")
+                        items.append((frozenset(locks_t), attrs_t))
+                except (ValueError, TypeError, SyntaxError) as e:
+                    project.collect_findings.append(Finding(
+                        self.name, sf.display, stmt.lineno,
+                        f"malformed {GUARD_DECL_NAME} on class {node.name}: {e} "
+                        "(expected {('lock', ...): ('attr', ...)} literals)",
+                    ))
+                    continue
+                site = f"{node.name} ({sf.display})"
+                for locks, attrs in items:
+                    for attr in attrs:
+                        prev = project.guarded.get(attr)
+                        if prev is not None and prev[0] != locks:
+                            project.collect_findings.append(Finding(
+                                self.name, sf.display, stmt.lineno,
+                                f"guarded attribute {attr!r} redeclared with "
+                                f"different locks (first declared by {prev[1]})",
+                            ))
+                            continue
+                        project.guarded[attr] = (locks, site)
+
+    # -- check --------------------------------------------------------------
+
+    def check(self, sf: SourceFile, project: Project):
+        if not project.guarded:
+            return
+        for node, ancestors in walk_with_ancestors(sf.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            entry = project.guarded.get(node.attr)
+            if entry is None:
+                continue
+            locks, decl_site = entry
+            func = nearest(ancestors, ast.FunctionDef, ast.AsyncFunctionDef)
+            if func is not None and (
+                func.name == "__init__" or func.name.endswith("_locked")
+            ):
+                continue
+            base = ast.unparse(node.value)
+            accepted = {f"{base}.{lk}" for lk in locks}
+            if self._held(ancestors, accepted):
+                continue
+            yield Finding(
+                self.name, sf.display, node.lineno,
+                f"'{base}.{node.attr}' accessed outside "
+                f"'with {base}.{{{'|'.join(sorted(locks))}}}:' "
+                f"(declared guarded by {decl_site})",
+            )
+
+    @staticmethod
+    def _held(ancestors, accepted: set[str]) -> bool:
+        """Scan ancestors innermost-out, stopping at the first function or
+        lambda boundary: a closure DEFINED inside `with lock:` runs after
+        the lock is released, so an enclosing with-block beyond the def
+        does not protect accesses in the closure body."""
+        for a in reversed(ancestors):
+            if isinstance(a, (ast.With, ast.AsyncWith)):
+                for item in a.items:
+                    if ast.unparse(item.context_expr) in accepted:
+                        return True
+            elif isinstance(
+                a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return False
+        return False

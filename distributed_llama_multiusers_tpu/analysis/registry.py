@@ -1,0 +1,24 @@
+"""Checker registry: the five project-invariant checks, in report order."""
+
+from __future__ import annotations
+
+from .clock_check import ClockChecker
+from .condvar_check import CondvarChecker
+from .core import Checker
+from .host_sync_check import HostSyncChecker
+from .lock_check import GuardedByChecker
+from .sharding_check import ShardingAxisChecker
+
+ALL_CHECKERS = (
+    GuardedByChecker,
+    HostSyncChecker,
+    ClockChecker,
+    CondvarChecker,
+    ShardingAxisChecker,
+)
+
+
+def default_checkers() -> list[Checker]:
+    """Fresh checker instances (checkers keep no state, but the Project
+    they fill does, so every run gets its own set)."""
+    return [cls() for cls in ALL_CHECKERS]

@@ -1,0 +1,116 @@
+"""sharding-axis: every named axis must be declared by the mesh builders.
+
+``parallel/mesh.py`` is the single source of truth for mesh axis names
+(``AXES = ("dp", "pp", "tp", "sp", "ep")``). A ``PartitionSpec`` /
+``shard_map`` spec / ``lax`` collective that names an axis the mesh
+builders never create fails only at trace time on a real mesh — which the
+CPU test tier rarely reaches — or worse, silently no-ops when the
+misspelled axis is treated as unsharded. This check catches it at lint
+time, package-wide:
+
+- ``P(...)`` / ``PartitionSpec(...)`` string and tuple-of-string args;
+- axis-name args of ``lax`` collectives (``psum``, ``pmax``, ``pmin``,
+  ``pmean``, ``ppermute``, ``all_gather``, ``all_to_all``,
+  ``axis_index``) and ``axis_name=`` keywords;
+- ``mesh.shape["..."]`` subscripts and ``*shape.get("...")`` lookups.
+
+Non-constant axis expressions (variables, ``*spec`` splats) are skipped —
+this is a lint for the literal 99% case, not an evaluator.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Finding, Project, SourceFile, last_component
+
+# mirror of parallel/mesh.py AXES — used only when no AXES declaration is
+# in the analyzed file set (e.g. single-file runs)
+DEFAULT_AXES = ("dp", "pp", "tp", "sp", "ep")
+
+SPEC_CALLS = {"P", "PartitionSpec"}
+COLLECTIVE_CALLS = {
+    "psum", "pmax", "pmin", "pmean", "ppermute", "all_gather",
+    "all_to_all", "axis_index", "psum_scatter",
+}
+AXIS_KWARGS = {"axis_name", "axis_names"}
+
+
+class ShardingAxisChecker(Checker):
+    name = "sharding-axis"
+    description = (
+        "PartitionSpec/shard_map/lax-collective axis names must be "
+        "declared by the mesh builders (parallel/mesh.py AXES)"
+    )
+
+    def collect(self, sf: SourceFile, project: Project) -> None:
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "AXES"
+            ):
+                continue
+            try:
+                axes = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                continue
+            if isinstance(axes, (tuple, list)) and all(
+                isinstance(a, str) for a in axes
+            ):
+                project.axes.update(axes)
+                project.axes_src = sf.display
+
+    def check(self, sf: SourceFile, project: Project):
+        axes = project.axes or set(DEFAULT_AXES)
+        src = project.axes_src or "the built-in default"
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(sf, node, axes, src)
+            elif isinstance(node, ast.Subscript):
+                # mesh.shape["tp"]
+                if (
+                    ast.unparse(node.value).endswith("shape")
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                ):
+                    yield from self._validate(
+                        sf, node.slice, node.slice.value, axes, src
+                    )
+
+    def _check_call(self, sf, node: ast.Call, axes, src):
+        name = last_component(node.func)
+        if name in SPEC_CALLS:
+            for arg in node.args:
+                yield from self._validate_expr(sf, arg, axes, src)
+        elif name in COLLECTIVE_CALLS:
+            for arg in node.args:
+                yield from self._validate_expr(sf, arg, axes, src)
+        elif (
+            name == "get"
+            and isinstance(node.func, ast.Attribute)
+            and ast.unparse(node.func.value).endswith("shape")
+            and node.args
+        ):
+            # mesh.shape.get("tp", 1) / mesh_shape.get("tp", 1)
+            yield from self._validate_expr(sf, node.args[0], axes, src)
+        for kw in node.keywords:
+            if kw.arg in AXIS_KWARGS:
+                yield from self._validate_expr(sf, kw.value, axes, src)
+
+    def _validate_expr(self, sf, expr: ast.AST, axes, src):
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            yield from self._validate(sf, expr, expr.value, axes, src)
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    yield from self._validate(sf, e, e.value, axes, src)
+
+    def _validate(self, sf, node: ast.AST, axis: str, axes, src):
+        if axis not in axes:
+            yield Finding(
+                self.name, sf.display, node.lineno,
+                f"axis {axis!r} is not declared by the mesh builders "
+                f"(AXES from {src}: {sorted(axes)})",
+            )

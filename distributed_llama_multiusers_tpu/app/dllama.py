@@ -21,6 +21,7 @@ import time
 import numpy as np
 
 from ..tokenizer import ChatItem, EosDetector, EosResult, Sampler, TokenizerChatStops, chat_generator_for
+from ..utils.seeds import fresh_seed
 from .args import build_parser
 from .runtime_setup import honor_cpu_platform_env, load_stack, log
 
@@ -135,7 +136,10 @@ def run_chat(args) -> None:
     config, params, tokenizer, engine = load_stack(args, n_lanes=1)
     generator = chat_generator_for(tokenizer, args.chat_template)
     stops = TokenizerChatStops(tokenizer)
-    sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or int(time.time()))
+    # unseeded chats draw OS entropy (utils/seeds.py), not wall-clock
+    # seconds: two sessions started in the same second must not replay
+    # identical sampling streams
+    sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or fresh_seed())
     # greedy chat gets the same prompt-lookup speculation as inference mode
     # — the interactive path is where per-token latency is most visible,
     # and chat output (code, lists, repeated names) drafts well

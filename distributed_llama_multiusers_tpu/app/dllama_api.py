@@ -43,6 +43,7 @@ def main(argv=None) -> None:
         # timeout — a second stop() here would only re-join a thread drain
         # already dealt with (and re-raise over drain's own failure report
         # when that thread is wedged in a hung device dispatch).
+        # dlint: ok[condvar] httpd.shutdown() in the finally ends serve_forever; the helper only spans the drain window
         accept_loop = threading.Thread(target=httpd.serve_forever, daemon=True)
         accept_loop.start()
         try:

@@ -45,6 +45,7 @@ from ..serving import (
     queue_expired,
 )
 from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerChatStops
+from ..utils.seeds import fresh_seed
 from .spec import NgramDraftIndex
 
 
@@ -452,8 +453,11 @@ class ContinuousBatchingScheduler:
         lane.pos = start
         lane.pending = list(tokens[start:])
         lane.drafter = NgramDraftIndex(tokens)  # seed with the prompt
+        # unseeded requests draw OS entropy (utils/seeds.py), not the wall
+        # clock: two requests admitted in the same clock tick must not
+        # sample identical streams, and NTP steps must not replay seeds
         lane.seed = (
-            req.seed if req.seed is not None else int(time.time() * 1e6)
+            req.seed if req.seed is not None else fresh_seed()
         ) & 0xFFFFFFFF
         lane.host_exact = self.host_sampling or (
             req.temperature > 0.0
@@ -510,6 +514,7 @@ class ContinuousBatchingScheduler:
         if req.temperature == 0.0:
             first = int(greedy)
         elif lane.host_exact:
+            # dlint: ok[host-sync] host-exact lane: one [n,vocab] f32 batch at prompt end, counted by all_logits
             first = lane.sampler.sample(self.engine.all_logits(logits))
         else:
             first = int(sampled)  # sampled inside the compiled prefill step
@@ -630,8 +635,15 @@ class ContinuousBatchingScheduler:
                 and self._lanes[i].request.state == RequestState.GENERATING
             ]
             if not active:
-                if not prefilled:
-                    self._stop.wait(0.001)
+                # Nothing decodable and no prompt chunk processed. This is
+                # only reachable when the cancel/expiry pass above freed
+                # every lane after the `occupied` snapshot was taken (an
+                # admitting lane implies prefilled; a generating lane
+                # implies active) — so loop straight back to the idle
+                # check, which parks on the queue's condition variable
+                # (QosQueue.pop wait / Queue.get) until the next push or
+                # the 0.25s stop-flag recheck, instead of busy-polling
+                # `self._stop` at 1ms as earlier revisions did.
                 continue
 
             tokens = np.zeros(n_lanes, np.int32)
@@ -708,6 +720,7 @@ class ContinuousBatchingScheduler:
             if any(
                 l.host_exact and l.request.temperature > 0 for _, l in active
             ):
+                # dlint: ok[host-sync] host-exact lanes only: ONE batched [n,vocab] f32 transfer, counted by all_logits
                 logits_np = self.engine.all_logits(logits)
 
             for i, lane in active:

@@ -92,7 +92,7 @@ def chat_completion_response(
     return {
         "id": f"chatcmpl-{req_id}",
         "object": "chat.completion",
-        "created": int(time.time()),
+        "created": int(time.time()),  # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
         "model": model,
         "generated_text": text,  # fork-compat field (dllama-api.cpp:283)
         "choices": [
@@ -119,7 +119,7 @@ def chat_chunk_response(
     return {
         "id": f"chatcmpl-{req_id}",
         "object": "chat.completion.chunk",
-        "created": int(time.time()),
+        "created": int(time.time()),  # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
         "model": model,
         "choices": [choice],
     }
@@ -153,7 +153,7 @@ def completion_response(
     return {
         "id": f"cmpl-{req_id}",
         "object": "text_completion",
-        "created": int(time.time()),
+        "created": int(time.time()),  # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
         "model": model,
         "generated_text": text,  # fork-compat field, same as the chat route
         "choices": [
@@ -173,7 +173,7 @@ def completion_chunk_response(
     return {
         "id": f"cmpl-{req_id}",
         "object": "text_completion",
-        "created": int(time.time()),
+        "created": int(time.time()),  # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
         "model": model,
         "choices": [
             {
@@ -189,6 +189,7 @@ def models_response(model: str) -> dict:
     return {
         "object": "list",
         "data": [
+            # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
             {"id": model, "object": "model", "created": int(time.time()), "owned_by": "user"}
         ],
     }

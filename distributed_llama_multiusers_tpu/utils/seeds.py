@@ -1,0 +1,29 @@
+"""Sampler seed generation — OS entropy, never the wall clock.
+
+``int(time.time())`` seeds (the seed repo's habit) hand identical sampler
+streams to every request that lands in the same clock tick — at
+million-user scale "two requests in the same microsecond" is the common
+case, not the corner — and an NTP step can even replay past seeds. dlint's
+``clock`` check bans wall-clock seeds; this is the sanctioned source.
+"""
+
+from __future__ import annotations
+
+import time
+
+# xorshift64* (tokenizer/sampler.py) has 0 as a fixed point: a zero seed
+# would sample token 0 forever. Substitute when entropy lands on 0.
+_ZERO_FALLBACK = 0x9E3779B9  # golden-ratio constant, arbitrary non-zero
+
+
+def fresh_seed() -> int:
+    """Fresh 32-bit sampler seed from OS entropy (``np.random.SeedSequence``
+    pools ``os.urandom``); monotonic-clock fallback where numpy is absent.
+    Never returns 0."""
+    try:
+        import numpy as np
+
+        seed = int(np.random.SeedSequence().generate_state(1)[0])
+    except ImportError:  # pragma: no cover - numpy is a hard dep elsewhere
+        seed = time.monotonic_ns() & 0xFFFFFFFF
+    return seed or _ZERO_FALLBACK

@@ -1,0 +1,568 @@
+"""dlint (distributed_llama_multiusers_tpu/analysis): the analyzer itself
+AND its verdict on the real tree.
+
+Two layers, per the PR-2 contract:
+
+- **self-tests** — every checker gets known-bad and known-good fixture
+  snippets (including waiver syntax), so the analyzer is regression-tested
+  as a program, not just trusted on its current verdict;
+- **the tier-1 gate** — the full package must analyze clean (zero
+  non-baselined findings). A new unlocked counter bump, un-waived
+  host-sync in the decode path, wall-clock read, busy-poll, or undeclared
+  sharding axis anywhere in the package fails this test.
+
+Pure-stdlib imports: these tests run without jax.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from distributed_llama_multiusers_tpu.analysis import (
+    DEFAULT_BASELINE,
+    PACKAGE_ROOT,
+    Analyzer,
+    analyze_paths,
+    default_checkers,
+    load_baseline,
+)
+from distributed_llama_multiusers_tpu.analysis.cli import main as dlint_main
+
+
+def run_on(tmp_path: Path, files: dict[str, str], baseline: set | None = None):
+    """Write fixture files under tmp_path and analyze them (no baseline
+    unless given). Returns the finding list."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    analyzer = Analyzer(default_checkers())
+    return analyzer.run([tmp_path], baseline=baseline or set(), root=tmp_path)
+
+
+def checks_of(findings):
+    return sorted(f.check for f in findings)
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+
+def test_package_analyzes_clean():
+    """THE gate: zero non-baselined findings over the real package. If this
+    fails, either fix the finding, waive it in place with a reason, or (last
+    resort) baseline it — see docs/LINT.md."""
+    findings = analyze_paths()
+    assert findings == [], "dlint findings on the tree:\n" + "\n".join(
+        f.render() for f in findings
+    )
+
+
+def test_cli_runs_clean_with_shipped_baseline(capsys):
+    assert dlint_main([]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_shipped_baseline_is_empty():
+    """Adoption fixed or waived everything; keep it that way."""
+    assert load_baseline(DEFAULT_BASELINE) == set()
+
+
+def test_real_decl_sites_are_collected():
+    """The EngineStats/QosQueue declarations actually reach the checker
+    (guards against the declaration syntax silently rotting)."""
+    from distributed_llama_multiusers_tpu.analysis.core import Project
+    from distributed_llama_multiusers_tpu.analysis.lock_check import GuardedByChecker
+    import ast
+
+    project = Project()
+    checker = GuardedByChecker()
+    for rel in ("runtime/engine.py", "serving/qos.py"):
+        p = PACKAGE_ROOT / rel
+        from distributed_llama_multiusers_tpu.analysis.core import SourceFile
+
+        sf = SourceFile(
+            path=p, display=rel, text=p.read_text(), tree=ast.parse(p.read_text())
+        )
+        checker.collect(sf, project)
+    assert "decode_steps" in project.guarded
+    assert "prefix_hits" in project.guarded
+    assert "_deficit" in project.guarded
+    assert project.guarded["_depth"][0] == frozenset({"_lock", "_not_empty"})
+
+
+# -- guarded-by --------------------------------------------------------------
+
+GUARDED_CLS = """
+    import threading
+
+    class Stats:
+        _dlint_guarded_by = {("lock",): ("hits", "misses")}
+
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.hits = 0
+            self.misses = 0
+"""
+
+
+def test_guarded_by_flags_unlocked_access(tmp_path):
+    findings = run_on(tmp_path, {"m.py": GUARDED_CLS + """
+        def bump(s):
+            s.hits += 1
+    """})
+    assert checks_of(findings) == ["guarded-by"]
+    assert "'s.hits'" in findings[0].message
+
+
+def test_guarded_by_engine_stats_shape(tmp_path):
+    """Acceptance-criterion demo: a guarded EngineStats-style counter
+    accessed outside stats.lock is a finding, even through a chain base
+    (self.engine.stats) and even when SOME lock is held — it must be the
+    declared lock on the SAME base."""
+    src = GUARDED_CLS + """
+        class Scheduler:
+            def __init__(self, engine):
+                self.engine = engine
+
+            def good(self):
+                with self.engine.stats.lock:
+                    self.engine.stats.hits += 1
+
+            def bad_unlocked(self):
+                self.engine.stats.hits += 1
+
+            def bad_wrong_base(self, other):
+                with other.stats.lock:
+                    self.engine.stats.hits += 1
+    """
+    findings = run_on(tmp_path, {"m.py": src})
+    assert checks_of(findings) == ["guarded-by", "guarded-by"]
+    lines = {f.line for f in findings}
+    assert len(lines) == 2
+
+
+def test_guarded_by_accepts_lock_locked_and_init(tmp_path):
+    findings = run_on(tmp_path, {"m.py": GUARDED_CLS + """
+        class User:
+            def ok_with(self, s):
+                with s.lock:
+                    s.hits += 1
+
+            def _bump_locked(self, s):
+                s.misses += 1  # caller holds s.lock by contract
+    """})
+    assert findings == []
+
+
+def test_guarded_by_alternate_locks_and_waiver(tmp_path):
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+
+        class Q:
+            _dlint_guarded_by = {("_lock", "_cv"): ("_depth",)}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._depth = 0
+
+            def push(self):
+                with self._cv:
+                    self._depth += 1
+
+            def empty(self):
+                # dlint: ok[guarded-by] advisory racy read by contract
+                return self._depth == 0
+    """})
+    assert findings == []
+
+
+def test_guarded_by_closure_in_with_block_is_not_protected(tmp_path):
+    """A closure defined inside `with lock:` runs after the lock is
+    released — the enclosing with must not count across the def/lambda
+    boundary."""
+    findings = run_on(tmp_path, {"m.py": GUARDED_CLS + """
+        def make_cb(s):
+            with s.lock:
+                cb = lambda: s.hits + 1
+                def cb2():
+                    return s.misses
+            return cb, cb2
+    """})
+    assert checks_of(findings) == ["guarded-by", "guarded-by"]
+
+
+def test_guarded_by_malformed_declaration(tmp_path):
+    findings = run_on(tmp_path, {"m.py": """
+        class Bad:
+            _dlint_guarded_by = {("lock",): 42}
+    """})
+    assert checks_of(findings) == ["guarded-by"]
+    assert "malformed" in findings[0].message
+
+
+# -- host-sync ---------------------------------------------------------------
+
+
+def test_host_sync_flags_unwaived_asarray_in_decode_path(tmp_path):
+    """Acceptance-criterion demo: a new un-waived host sync in the decode
+    path is a finding."""
+    src = """
+        import numpy as np
+
+        def decode(logits):
+            return np.asarray(logits)
+    """
+    findings = run_on(tmp_path, {"runtime/engine.py": src})
+    assert checks_of(findings) == ["host-sync"]
+    # the same code OUTSIDE the decode-path scope is not flagged
+    assert run_on(tmp_path / "other", {"models/llama.py": src}) == []
+
+
+def test_host_sync_waiver_suppresses(tmp_path):
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        import numpy as np
+
+        def decode(logits):
+            # dlint: ok[host-sync] the one packed readback per step
+            return np.asarray(logits)
+    """})
+    assert findings == []
+
+
+def test_host_sync_flags_item_and_cast(tmp_path):
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        def f(x, toks_np):
+            a = x.item()
+            b = int(x)
+            c = int(toks_np[0])  # *_np host-array convention: exempt
+            return a, b, c
+    """})
+    assert checks_of(findings) == ["host-sync", "host-sync"]
+
+
+def test_host_sync_cast_rule_is_engine_only(tmp_path):
+    findings = run_on(tmp_path, {"runtime/scheduler.py": """
+        def f(greedy):
+            return int(greedy[0])  # host numpy from the engine: fine here
+    """})
+    assert findings == []
+
+
+def test_host_sync_implicit_bool_on_compiled_step_output(tmp_path):
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        class E:
+            def step(self, x):
+                logits, toks = self._decode_fn(x)
+                if logits:
+                    return toks
+                return None
+    """})
+    assert checks_of(findings) == ["host-sync"]
+    assert "implicit bool" in findings[0].message
+
+
+# -- clock -------------------------------------------------------------------
+
+
+def test_clock_flags_time_time_everywhere(tmp_path):
+    findings = run_on(tmp_path, {"anywhere/mod.py": """
+        import time
+
+        def seed():
+            return int(time.time())
+    """})
+    assert checks_of(findings) == ["clock"]
+
+
+def test_clock_accepts_monotonic_and_waived_timestamps(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": """
+        import time
+
+        def dur():
+            return time.monotonic() + time.perf_counter()
+
+        def created():
+            return int(time.time())  # dlint: ok[clock] absolute API timestamp
+    """})
+    assert findings == []
+
+
+def test_clock_is_import_aware(tmp_path):
+    """`from time import time` and `import time as t` must not bypass the
+    wall-clock ban (the dotted-attribute spelling is not the only one)."""
+    findings = run_on(tmp_path, {"a.py": """
+        from time import time
+
+        def deadline():
+            return time() + 5.0
+    """})
+    assert checks_of(findings) == ["clock"]
+    assert "from time import time" in findings[0].message
+    findings = run_on(tmp_path / "b", {"b.py": """
+        import time as t
+
+        def seed():
+            return int(t.time())
+    """})
+    assert checks_of(findings) == ["clock"]
+
+
+def test_clock_flags_naive_datetime_now(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": """
+        from datetime import datetime
+
+        def now():
+            return datetime.now()
+    """})
+    assert checks_of(findings) == ["clock"]
+
+
+# -- condvar -----------------------------------------------------------------
+
+
+def test_condvar_wait_needs_predicate_loop(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._n = 0
+
+            def bad(self):
+                with self._cv:
+                    self._cv.wait()
+
+            def good_loop(self):
+                with self._cv:
+                    while self._n == 0:
+                        self._cv.wait()
+
+            def good_wait_for(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self._n > 0)
+    """})
+    assert checks_of(findings) == ["condvar"]
+    assert "predicate loop" in findings[0].message
+
+
+def test_condvar_flags_event_busy_poll(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": """
+        import threading
+
+        class Loop:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def bad(self):
+                while not self._stop.is_set():
+                    self._stop.wait(0.001)
+
+            def good(self):
+                self._stop.wait(0.25)
+    """})
+    assert checks_of(findings) == ["condvar"]
+    assert "busy-poll" in findings[0].message
+
+
+def test_condvar_daemon_thread_needs_join(tmp_path):
+    bad = """
+        import threading
+
+        def serve():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+    """
+    findings = run_on(tmp_path, {"mod.py": bad})
+    assert checks_of(findings) == ["condvar"]
+    assert "join" in findings[0].message
+    good = """
+        import threading
+
+        class S:
+            def start(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._t.join(timeout=30)
+    """
+    assert run_on(tmp_path / "g", {"mod.py": good}) == []
+
+
+# -- sharding-axis -----------------------------------------------------------
+
+
+def test_sharding_axis_must_be_declared(tmp_path):
+    """Acceptance-criterion demo: a PartitionSpec naming an axis the mesh
+    builders never create is a finding."""
+    findings = run_on(tmp_path, {
+        "parallel/mesh.py": 'AXES = ("dp", "tp")\n',
+        "parallel/sharding.py": """
+            from jax.sharding import PartitionSpec as P
+
+            GOOD = P("dp", None, "tp")
+            BAD = P("dp", "model")
+        """,
+    })
+    assert checks_of(findings) == ["sharding-axis"]
+    assert "'model'" in findings[0].message
+
+
+def test_sharding_axis_covers_collectives_and_shape_lookups(tmp_path):
+    findings = run_on(tmp_path, {
+        "parallel/mesh.py": 'AXES = ("dp", "tp", "sp")\n',
+        "parallel/ops.py": """
+            import jax
+
+            def f(x, mesh):
+                a = jax.lax.psum(x, "sp")
+                b = jax.lax.ppermute(x, "ring", [(0, 1)])
+                n = mesh.shape["tp"]
+                m = mesh.shape.get("oops", 1)
+                return a, b, n, m
+        """,
+    })
+    assert checks_of(findings) == ["sharding-axis", "sharding-axis"]
+    msgs = " ".join(f.message for f in findings)
+    assert "'ring'" in msgs and "'oops'" in msgs
+
+
+def test_sharding_axis_default_axes_without_decl(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": """
+        from jax.sharding import PartitionSpec as P
+
+        OK = P("tp")
+        BAD = P("nope")
+    """})
+    assert checks_of(findings) == ["sharding-axis"]
+
+
+# -- waiver hygiene ----------------------------------------------------------
+
+
+def test_bare_waiver_is_a_finding(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": """
+        import time
+
+        def f():
+            return time.time()  # dlint: ok[clock]
+    """})
+    # the bare waiver is rejected AND therefore does not suppress the clock
+    # finding either
+    assert checks_of(findings) == ["clock", "waiver"]
+    assert "without a reason" in [f for f in findings if f.check == "waiver"][0].message
+
+
+def test_unknown_check_name_in_waiver(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": """
+        X = 1  # dlint: ok[not-a-check] some reason
+    """})
+    assert checks_of(findings) == ["waiver"]
+    assert "unknown check" in findings[0].message
+
+
+def test_waiver_only_covers_named_check(tmp_path):
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        import numpy as np
+        import time
+
+        def f(logits):
+            # dlint: ok[clock] wrong check name for this line
+            return np.asarray(logits)
+
+        def g():
+            return time.time()  # dlint: ok[host-sync] also wrong
+    """})
+    assert checks_of(findings) == ["clock", "host-sync"]
+
+
+def test_star_waiver_and_standalone_placement(tmp_path):
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        import numpy as np
+
+        def f(logits):
+            # dlint: ok[*] benchmark probe: sync everything on purpose
+            return np.asarray(logits)
+    """})
+    assert findings == []
+
+
+def test_waiver_in_string_literal_does_not_suppress(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": '''
+        import time
+
+        def f():
+            doc = "# dlint: ok[clock] not a comment"
+            return time.time(), doc
+    '''})
+    assert checks_of(findings) == ["clock"]
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def test_baseline_suppresses_only_listed_findings(tmp_path):
+    files = {"mod.py": """
+        import time
+
+        def f():
+            return time.time()
+
+        def g():
+            return datetime.datetime.now()
+
+        import datetime
+    """}
+    all_findings = run_on(tmp_path, files)
+    assert len(all_findings) == 2
+    baseline = {all_findings[0].key}
+    remaining = run_on(tmp_path, files, baseline=baseline)
+    assert len(remaining) == 1
+    assert remaining[0].key == all_findings[1].key
+
+
+def test_write_baseline_roundtrip(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text("import time\nT = time.time()\n")
+    bl = tmp_path / "bl.txt"
+    assert dlint_main([str(tmp_path), "--baseline", str(bl), "--write-baseline"]) == 0
+    assert bl.exists()
+    capsys.readouterr()
+    # with the written baseline the same tree is clean
+    assert dlint_main([str(tmp_path), "--baseline", str(bl)]) == 0
+    # without it, the finding is back
+    assert dlint_main([str(tmp_path), "--no-baseline", "--baseline", str(bl)]) == 1
+
+
+def test_write_baseline_excludes_unbaselinable_findings(tmp_path, capsys):
+    """waiver/parse findings are never filtered by the baseline, so writing
+    their keys would strand dead entries while the gate keeps failing; the
+    CLI must report them and exit 1 instead."""
+    (tmp_path / "mod.py").write_text(
+        "import time\nT = time.time()  # dlint: ok[clock]\n"
+    )
+    bl = tmp_path / "bl.txt"
+    rc = dlint_main([str(tmp_path), "--baseline", str(bl), "--write-baseline"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "cannot be baselined" in out
+    keys = [
+        line for line in bl.read_text().splitlines()
+        if line.strip() and not line.startswith("#")
+    ]
+    # the clock finding (un-suppressed by the bare waiver) was baselined;
+    # the waiver finding was not
+    assert len(keys) == 1 and keys[0].startswith("clock\t")
+
+
+def test_cli_missing_path_is_usage_error(tmp_path):
+    assert dlint_main([str(tmp_path / "nope")]) == 2
+
+
+def test_syntax_error_is_a_parse_finding(tmp_path):
+    findings = run_on(tmp_path, {"mod.py": "def broken(:\n"})
+    assert checks_of(findings) == ["parse"]
