@@ -8,13 +8,15 @@ reviewer memory. This package machine-checks them — the Python/JAX
 analogue of the reference repo's sanitizer CI for C++ (SURVEY.md §5.2,
 mirrored by ``make sanitize``).
 
-Five checks (docs/LINT.md has the full contract and waiver policy):
+Six checks (docs/LINT.md has the full contract and waiver policy):
 
-- ``guarded-by``   — lock discipline for declared shared attributes
-- ``host-sync``    — explicit, waived device->host transfers in decode
-- ``clock``        — no wall clock for durations/deadlines/seeds
-- ``condvar``      — predicate loops, no busy-polls, joined threads
-- ``sharding-axis``— PartitionSpec/collective axes declared by the mesh
+- ``guarded-by``    — lock discipline for declared shared attributes
+- ``host-sync``     — explicit, waived device->host transfers in decode
+- ``pipeline-sync`` — NO host syncs at all in the async-pipeline dispatch
+  half (engine.decode_pipelined / scheduler._pipeline_dispatch)
+- ``clock``         — no wall clock for durations/deadlines/seeds
+- ``condvar``       — predicate loops, no busy-polls, joined threads
+- ``sharding-axis`` — PartitionSpec/collective axes declared by the mesh
 
 Usage::
 

@@ -1,0 +1,153 @@
+"""pipeline-sync: the pipelined dispatch half must never touch the host.
+
+The async decode pipeline's whole point is that the dispatch half
+(``engine.decode_pipelined``, ``scheduler._pipeline_dispatch``) enqueues
+the next device step from host METADATA only — the tokens feeding it stay
+on device. One stray ``np.asarray`` / ``.item()`` / implicit bool of a
+device value in there blocks the host on the in-flight step and silently
+re-serializes the chain: the code still produces identical streams, so
+nothing but a latency graph would ever catch it. This check makes the
+regression a lint failure instead.
+
+Scope: functions named in ``PIPELINE_FUNCS`` inside ``runtime/engine.py``
+and ``runtime/scheduler.py`` (the two halves the scheduler restructure
+created). Stricter than host-sync (which also covers these files): inside
+the dispatch half even a *counted, waived-elsewhere-style* transfer is
+wrong by construction, so every sync construct needs its own explicit
+``# dlint: ok[pipeline-sync] reason`` — and there should essentially never
+be one.
+
+Rules (same constructs host-sync knows, scoped to the dispatch half):
+
+1. **transfer calls** — ``np.asarray`` / ``np.array`` / ``jax.device_get``
+   calls and ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` /
+   ``.all_logits()`` / ``.lane_logits()`` method calls;
+2. **casts** — ``int()`` / ``float()`` / ``bool()`` over a name that is
+   not host-annotated (``*_np`` / ``*_host``);
+3. **implicit bool** — ``if x:`` / ``while x:`` / ``assert x`` on a value
+   assigned from a compiled-step call (``*_fn`` / ``*_exec`` names).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (
+    Checker,
+    Finding,
+    Project,
+    SourceFile,
+    last_component,
+    root_name,
+)
+
+SCOPE = ("runtime/engine.py", "runtime/scheduler.py")
+# the dispatch halves by name: the engine's public dispatch entry point and
+# the scheduler's dispatch-half method
+PIPELINE_FUNCS = ("decode_pipelined", "_pipeline_dispatch")
+
+SYNC_METHODS = {"item", "tolist", "block_until_ready", "all_logits",
+                "lane_logits", "device_get"}
+SYNC_FUNCS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+              "jax.device_get"}
+CASTS = {"int", "float", "bool"}
+DEVICE_FN_RE = re.compile(r"(_fn|_exec)$")
+HOST_NAME_RE = re.compile(r"(_np|_host)$")
+
+
+class PipelineSyncChecker(Checker):
+    name = "pipeline-sync"
+    description = (
+        "host-sync constructs inside the pipelined dispatch half "
+        "(engine.decode_pipelined / scheduler._pipeline_dispatch) "
+        "re-serialize the async chain"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        if not sf.endswith(*SCOPE):
+            return
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in PIPELINE_FUNCS
+            ):
+                yield from self._check_fn(sf, node)
+
+    def _check_fn(self, sf: SourceFile, fn):
+        # names assigned from compiled-step calls: implicit bool on them
+        # blocks on the device (host-sync rule 3, scoped here)
+        tainted: set[str] = set()
+        for stmt in ast.walk(fn):
+            if not (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)):
+                continue
+            last = last_component(stmt.value.func)
+            if last is not None and DEVICE_FN_RE.search(last):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        tainted.update(
+                            e.id for e in tgt.elts if isinstance(e, ast.Name)
+                        )
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(sf, fn, node)
+            elif isinstance(node, (ast.If, ast.While, ast.Assert)):
+                for name in self._bool_names(node.test):
+                    if name in tainted:
+                        yield Finding(
+                            self.name, sf.display, node.lineno,
+                            f"implicit bool of device value '{name}' inside "
+                            f"dispatch half '{fn.name}' blocks on the "
+                            "in-flight step and re-serializes the pipeline",
+                        )
+
+    def _check_call(self, sf: SourceFile, fn, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in SYNC_METHODS:
+            yield Finding(
+                self.name, sf.display, node.lineno,
+                f"device->host sync '{ast.unparse(func)}(...)' inside "
+                f"dispatch half '{fn.name}' re-serializes the pipeline; "
+                "move it to the consume half or waive with "
+                "'# dlint: ok[pipeline-sync] <why>'",
+            )
+            return
+        if ast.unparse(func) in SYNC_FUNCS:
+            yield Finding(
+                self.name, sf.display, node.lineno,
+                f"device->host sync '{ast.unparse(func)}(...)' inside "
+                f"dispatch half '{fn.name}' re-serializes the pipeline; "
+                "move it to the consume half or waive with "
+                "'# dlint: ok[pipeline-sync] <why>'",
+            )
+            return
+        if (
+            isinstance(func, ast.Name)
+            and func.id in CASTS
+            and len(node.args) == 1
+            and not node.keywords
+            and isinstance(node.args[0], (ast.Name, ast.Attribute, ast.Subscript))
+        ):
+            root = root_name(node.args[0])
+            if root is not None and not HOST_NAME_RE.search(root):
+                yield Finding(
+                    self.name, sf.display, node.lineno,
+                    f"cast '{func.id}({ast.unparse(node.args[0])})' inside "
+                    f"dispatch half '{fn.name}' may sync a device value; "
+                    "read host metadata instead or waive",
+                )
+
+    @staticmethod
+    def _bool_names(test: ast.AST) -> list[str]:
+        if isinstance(test, ast.Name):
+            return [test.id]
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return PipelineSyncChecker._bool_names(test.operand)
+        if isinstance(test, ast.BoolOp):
+            out: list[str] = []
+            for v in test.values:
+                out.extend(PipelineSyncChecker._bool_names(v))
+            return out
+        return []

@@ -1,4 +1,4 @@
-"""Checker registry: the five project-invariant checks, in report order."""
+"""Checker registry: the six project-invariant checks, in report order."""
 
 from __future__ import annotations
 
@@ -7,11 +7,13 @@ from .condvar_check import CondvarChecker
 from .core import Checker
 from .host_sync_check import HostSyncChecker
 from .lock_check import GuardedByChecker
+from .pipeline_check import PipelineSyncChecker
 from .sharding_check import ShardingAxisChecker
 
 ALL_CHECKERS = (
     GuardedByChecker,
     HostSyncChecker,
+    PipelineSyncChecker,
     ClockChecker,
     CondvarChecker,
     ShardingAxisChecker,

@@ -104,6 +104,15 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "token streams, 1/h the per-token dispatch "
                         "overhead); 0 disables; default: scheduler "
                         "default (8)")
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   help="serving: async decode pipeline — bound on "
+                        "dispatched-but-unconsumed decode steps. Step k+1 "
+                        "dispatches from the on-device token carry while "
+                        "step k's host readback (detokenize, stream, "
+                        "stop/EOS checks) runs one step behind, overlapped "
+                        "with device execution; token streams stay "
+                        "byte-identical to synchronous stepping. 0 or 1 "
+                        "disables; default: engine default (2)")
     # train mode (beyond parity — no reference analogue)
     p.add_argument("--data", default=None,
                    help="train: UTF-8 text file tokenized into training batches")

@@ -174,6 +174,9 @@ def load_stack(args, n_lanes: int | None = None):
         q80_sync=q80_sync,
         mesh=mesh,
         replicate_outputs=n_proc > 1,
+        # async decode pipeline ring bound (None -> engine default 2);
+        # every process must agree, like --max-lanes
+        pipeline_depth=getattr(args, "pipeline_depth", None),
     )
     if n_proc > 1:
         from ..parallel.multihost import ControlPlane, RootControlEngine

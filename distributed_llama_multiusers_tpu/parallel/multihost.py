@@ -32,6 +32,11 @@ OP_DECODE_SPEC = 3
 OP_STATS_RESET = 4  # zero worker-side engine counters (post-warmup hygiene)
 OP_COPY_LANE = 5  # prefix caching: copy one lane's KV into another
 OP_DECODE_MULTI = 6  # h chained decode steps in one dispatch (h in header)
+OP_DECODE_PIPELINED = 7  # async pipelined step: device-fed token carry,
+# feed flag + ring depth in the header, so workers replay the same chain
+OP_PIPELINE_FLUSH = 8  # root ended/aborted a pipelined chain: workers drain
+# their own rings and drop their carries (no device program to replay, but
+# a worker holding stale in-flight steps pins device buffers between chains)
 
 
 def maybe_initialize_distributed(args=None) -> int:
@@ -82,6 +87,14 @@ class ControlPlane:
     speculative verify steps replay on pods too.
     DECODE_MULTI: the DECODE slots; the horizon h rides the start_pos
     header field (multi-step decode replays as one packet per h steps).
+    DECODE_PIPELINED: the DECODE slots; the ``lane`` header field carries
+    the feed flag (1 = host tokens in slot 0 reseed the chain after a
+    flush, 0 = continue from the worker's own device carry) and
+    ``start_pos`` carries the ring depth, so every process runs the same
+    async chain with the same bounded lag.
+    DECODE also rides its want_logits flag in the ``lane`` header field:
+    the logits-materializing and no-logits steps are different compiled
+    programs, and every process must dispatch the same one.
     """
 
     HEADER = 4
@@ -116,8 +129,10 @@ class ControlPlane:
 
     def send_prefill(
         self, lane: int, tokens, start_pos: int,
-        temp: float = 0.0, topp: float = 0.9, seed: int = 0,
+        temp: float = 0.0, topp: float | None = None, seed: int = 0,
     ) -> None:
+        if topp is None:  # one default for every sampling surface
+            from ..runtime.engine import DEFAULT_TOPP as topp
         tbits = np.asarray([temp], np.float32).view(np.int32)
         pbits = np.asarray([topp], np.float32).view(np.int32)
         sbits = np.asarray([seed & 0xFFFFFFFF], np.uint32).view(np.int32)
@@ -129,16 +144,31 @@ class ControlPlane:
             )
 
     def send_decode(
-        self, tokens, positions, temps=None, topps=None, seeds=None
+        self, tokens, positions, temps=None, topps=None, seeds=None,
+        want_logits: bool = True,
     ) -> None:
         n = len(tokens)
         as_bits = lambda f: (
             None if f is None else np.asarray(f, np.float32).view(np.int32)
         )
         self._send(
-            OP_DECODE, 0, n, 0,
+            OP_DECODE, 1 if want_logits else 0, n, 0,
             tokens, positions, as_bits(temps), as_bits(topps),
             None if seeds is None else np.asarray(seeds, np.uint32).view(np.int32),
+        )
+
+    def send_decode_pipelined(
+        self, tokens, positions, temps, topps, seeds, depth: int
+    ) -> None:
+        n = len(positions)
+        # feed flag rides `lane` (tokens present = chain reseed), ring
+        # depth rides `start_pos` — workers mirror the root's bounded lag
+        self._send(
+            OP_DECODE_PIPELINED, 0 if tokens is None else 1, n, depth,
+            tokens, positions,
+            np.asarray(temps, np.float32).view(np.int32),
+            np.asarray(topps, np.float32).view(np.int32),
+            np.asarray(seeds, np.uint32).view(np.int32),
         )
 
     def send_decode_spec(
@@ -174,6 +204,9 @@ class ControlPlane:
             np.asarray(seeds, np.uint32).view(np.int32),
         )
 
+    def send_pipeline_flush(self) -> None:
+        self._send(OP_PIPELINE_FLUSH, 0, 0, 0)
+
     def send_stop(self) -> None:
         self._send(OP_STOP, 0, 0, 0)
 
@@ -203,8 +236,10 @@ class RootControlEngine:
 
     def prefill_chunk(
         self, lane: int, chunk, start_pos: int,
-        temp: float = 0.0, topp: float = 0.9, seed: int = 0,
+        temp: float = 0.0, topp: float | None = None, seed: int = 0,
     ):
+        if topp is None:  # byte-identical default on packet AND root call
+            from ..runtime.engine import DEFAULT_TOPP as topp
         # validate BEFORE broadcasting: every packet must pair with exactly
         # one root-side compute, or workers dispatch collective programs the
         # root never runs and the pod deadlocks. Empty chunks send 0 packets;
@@ -225,8 +260,10 @@ class RootControlEngine:
 
     def prefill(
         self, lane: int, tokens, start_pos: int = 0,
-        temp: float = 0.0, topp: float = 0.9, seed: int = 0,
+        temp: float = 0.0, topp: float | None = None, seed: int = 0,
     ):
+        if topp is None:  # byte-identical default on packet AND root call
+            from ..runtime.engine import DEFAULT_TOPP as topp
         # one packet, then the matching compute, per chunk: workers replay
         # each packet with a blocking engine call, so broadcasting the whole
         # prompt up front would deadlock the pod on prompts > plane.chunk
@@ -251,20 +288,53 @@ class RootControlEngine:
         """Packet and root-side engine call must carry byte-identical
         sampling values (workers replay from the packet) — one place owns
         the defaults for every op type."""
+        from ..runtime.engine import DEFAULT_TOPP
+
         n = self._engine.n_lanes
         return (
             np.zeros(n, np.float32) if temps is None else np.asarray(temps, np.float32),
-            np.full(n, 0.9, np.float32) if topps is None else np.asarray(topps, np.float32),
+            np.full(n, DEFAULT_TOPP, np.float32) if topps is None else np.asarray(topps, np.float32),
             np.zeros(n, np.uint32) if seeds is None else np.asarray(seeds, np.uint32),
         )
 
-    def decode(self, tokens, positions, temps=None, topps=None, seeds=None):
+    def decode(self, tokens, positions, temps=None, topps=None, seeds=None,
+               want_logits: bool = True):
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode(
             np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
-            temps, topps, seeds,
+            temps, topps, seeds, want_logits=want_logits,
         )
-        return self._engine.decode(tokens, positions, temps, topps, seeds)
+        return self._engine.decode(
+            tokens, positions, temps, topps, seeds, want_logits=want_logits
+        )
+
+    def decode_pipelined(
+        self, positions, temps=None, topps=None, seeds=None, tokens=None
+    ):
+        """Pipelined dispatch on a pod: the packet goes out first, then the
+        root enqueues its own half of the async chain. Consume/flush are
+        host-only (they dispatch no device program, so there is nothing to
+        replay) and forward through __getattr__; workers bound their own
+        rings from the depth in the header."""
+        temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._plane.send_decode_pipelined(
+            None if tokens is None else np.asarray(tokens, np.int32),
+            np.asarray(positions, np.int32), temps, topps, seeds,
+            depth=getattr(self._engine, "pipeline_depth", 2),
+        )
+        return self._engine.decode_pipelined(
+            positions, temps, topps, seeds, tokens=tokens
+        )
+
+    def pipeline_flush(self) -> int:
+        """Chain end/abort on a pod: tell the workers so they drain their
+        own rings too — the root's drain happens through its local consume
+        calls (no packets), so without this broadcast a worker would carry
+        stale in-flight steps (pinned device buffers) across chains and
+        into the post-warmup stats reset. Flush replays no device program;
+        the packet broadcast itself is the only collective involved."""
+        self._plane.send_pipeline_flush()
+        return self._engine.pipeline_flush()
 
     def decode_spec(
         self, tokens, drafts, draft_len, positions,
@@ -349,6 +419,23 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 2, n).view(np.float32),
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
+                want_logits=bool(lane),  # same compiled program as the root
+            )
+        elif op == OP_DECODE_PIPELINED:
+            # feed flag rides `lane`, ring depth rides `start_pos`. The
+            # worker mirrors the root's bounded lag: consume (its own
+            # harmless readback) only when its ring would exceed the bound,
+            # and drop the whole chain when the root reseeds after a flush.
+            if lane:
+                engine.pipeline_flush(count=False)  # reseed: same lagged drain
+            elif engine.pipeline_inflight() >= max(1, start_pos):
+                engine.pipeline_consume()
+            engine.decode_pipelined(
+                plane.slot(pkt, 1, n),
+                plane.slot(pkt, 2, n).view(np.float32),
+                plane.slot(pkt, 3, n).view(np.float32),
+                plane.slot(pkt, 4, n).view(np.uint32),
+                tokens=plane.slot(pkt, 0, n) if lane else None,
             )
         elif op == OP_DECODE_SPEC:
             k = engine.SPEC_DRAFT
@@ -370,6 +457,14 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 4, n).view(np.uint32),
                 start_pos,  # horizon h rides the start_pos header field
             )
+        elif op == OP_PIPELINE_FLUSH:
+            # the root ended/aborted a pipelined chain: drop this worker's
+            # lagged ring + carry so no stale step survives into the next
+            # chain (or into a post-warmup stats reset). count=False: the
+            # worker ring lags the root by design, so holding steps at a
+            # CLEAN chain end is expected — counting it would read as
+            # constant aborts in worker-side stats
+            engine.pipeline_flush(count=False)
         elif op == OP_STATS_RESET:
             # warmup traffic must not pollute worker-side counters either
             # (the root restores its own via stats.preserved())

@@ -28,6 +28,7 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from enum import Enum
@@ -46,6 +47,7 @@ from ..serving import (
 )
 from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerChatStops
 from ..utils.seeds import fresh_seed
+from .engine import DEFAULT_TOPP
 from .spec import NgramDraftIndex
 
 
@@ -68,7 +70,7 @@ class Request:
     prompt: str
     max_tokens: int = 128
     temperature: float = 0.0
-    topp: float = 0.9
+    topp: float = DEFAULT_TOPP
     seed: int | None = None
     stop: list[str] = field(default_factory=list)
     add_bos: bool = True
@@ -187,6 +189,7 @@ class ContinuousBatchingScheduler:
         prefix_min_tokens: int = 16,
         multi_step: int = 8,
         deadlines: DeadlinePolicy | None = None,
+        pipelined: bool = True,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
@@ -213,6 +216,19 @@ class ContinuousBatchingScheduler:
         new admission takes effect at the next horizon boundary. 0 or 1
         disables.
 
+        ``pipelined`` (default on, engines with ``pipeline_depth > 1``
+        only): in steady-state decode with no drafts to verify, dispatch
+        step k+1 from the engine's ON-DEVICE token carry while step k's
+        host readback (detokenize, stream deltas, stop/EOS/deadline
+        checks) runs one step behind, overlapped with the device — the
+        synchronous dispatch→block→consume cycle leaves the accelerator
+        idle for the whole host half. Token streams are byte-identical to
+        the synchronous path (the device feed rule applies the same
+        where(temp==0, greedy, sampled) select with the same
+        fold_in(seed, pos) draws). Speculation drafts, host-exact lanes,
+        a queued admission, or a prefill force a flush back to the
+        synchronous path.
+
         ``deadlines`` (serving/deadlines.py): server-wide queue-wait
         timeout and wall-clock generation budget; expired requests finish
         with ``finish_reason="timeout"`` (queued ones without ever taking a
@@ -233,6 +249,7 @@ class ContinuousBatchingScheduler:
         self.speculative = speculative
         self.prefix_min_tokens = prefix_min_tokens
         self.multi_step = multi_step
+        self.pipelined = pipelined
         self._lanes = [_Lane() for _ in range(engine.n_lanes)]
         # tokens whose KV each lane's cache currently holds at slots
         # [0, len): survives request finish (the KV physically remains),
@@ -581,6 +598,143 @@ class ContinuousBatchingScheduler:
         p = pow2_floor(min(self.multi_step, rem))
         return p if p > 1 else 0
 
+    def _pipeline_ok(self, active, prefilled: bool) -> bool:
+        """Gate for the pipelined path — the multi-step gate's steady-state
+        conditions (no prompt chunk this iteration, nothing queued, no
+        host-exact-sampling lane) plus engine support and a ring depth that
+        actually buys a lag. Drafts are the caller's business: when the
+        speculative probe produced any, the spec path runs instead."""
+        if not self.pipelined or prefilled:
+            return False
+        if not getattr(self.engine, "supports_pipelined", False):
+            return False
+        if getattr(self.engine, "pipeline_depth", 0) < 2:
+            return False
+        if not self.queue.empty():
+            return False
+        return not any(
+            l.host_exact and l.request.temperature > 0 for _, l in active
+        )
+
+    def _pipeline_dispatch(self, live: dict, pl_pos: dict, feed) -> None:
+        """Dispatch half of the pipelined loop: queue the next decode step
+        from host-side lane METADATA only — positions (the scheduler knows
+        each consumed step advances a live lane by exactly 1) and sampling
+        params. The tokens stay on device (``feed=None`` selects the
+        engine's carry); nothing in here may read a device value back, or
+        the whole overlap dies — machine-checked by dlint's pipeline-sync."""
+        engine = self.engine
+        n_lanes = engine.n_lanes
+        seq_len = engine.config.seq_len
+        # idle/finished lanes park at seq_len: the mode="drop" KV scatter
+        # discards their junk writes (same rule as the sync loop)
+        positions = np.full(n_lanes, seq_len, np.int32)
+        temps = np.zeros(n_lanes, np.float32)
+        topps = np.full(n_lanes, DEFAULT_TOPP, np.float32)
+        seeds = np.zeros(n_lanes, np.uint32)
+        for i, lane in live.items():
+            # a dispatch racing ahead of a not-yet-discovered length stop
+            # may overrun seq_len; clamp to the drop sentinel (its output
+            # is discarded at consume time anyway)
+            positions[i] = min(pl_pos[i], seq_len)
+            temps[i] = lane.request.temperature
+            topps[i] = lane.request.topp
+            seeds[i] = lane.seed
+        engine.decode_pipelined(positions, temps, topps, seeds, tokens=feed)
+
+    def _pipeline_consume(self, live: dict, step_lanes: tuple) -> None:
+        """Consume half, one step behind: block on the oldest in-flight
+        step's [2, n] token readback and run the host work the synchronous
+        loop does inline — stream decode, EOS/stop, cancel/budget checks —
+        while the younger dispatches keep the device busy. ``step_lanes``
+        is the live-lane set AT DISPATCH TIME: a lane that finished at an
+        earlier consumed step contributes a junk column, skipped here (its
+        in-flight KV writes die under the overwrite-before-readable rule)."""
+        greedy_np, sampled_np = self.engine.pipeline_consume()
+        now = time.monotonic()
+        for i in step_lanes:
+            lane = live.get(i)
+            if lane is None:
+                continue  # finished at an earlier consumed step: junk column
+            req = lane.request
+            if req._cancelled.is_set():
+                self._finish(i, req, reason="cancelled")
+                live.pop(i)
+                continue
+            if budget_expired(req, self.deadlines, now):
+                self.budget_timeouts += 1
+                self._finish(i, req, reason="timeout")
+                live.pop(i)
+                continue
+            if not self._consume(i, lane, lane.next_token):
+                live.pop(i)
+                continue
+            # the token this lane fed into the NEXT in-flight step — the
+            # on-device feed rule, reconstructed for host bookkeeping
+            if req.temperature == 0.0:
+                lane.next_token = int(greedy_np[i])
+            else:
+                lane.next_token = int(sampled_np[i])
+
+    def _run_pipelined(self, active) -> None:
+        """Steady-state pipelined decode: keep the ring at ``pipeline_depth``
+        dispatched steps, consuming the oldest one step behind — step k's
+        detokenize/stream/stop work overlaps step k+1's device execution
+        instead of serializing ahead of it.
+
+        Exits by DRAINING the remaining in-flight steps through the normal
+        consume path (their tokens are valid — no generated token is ever
+        discarded for a live lane) when a flush condition appears: stop(),
+        a queued admission (the sync loop admits and prefills), a greedy
+        lane whose history now drafts (the spec path emits >1 token per
+        forward and wins), or every lane finishing. An exit with lanes
+        still live counts as a pipeline flush in the engine stats."""
+        engine = self.engine
+        depth = max(2, int(getattr(engine, "pipeline_depth", 2)))
+        live: dict[int, _Lane] = dict(active)
+        # per-lane position of the NEXT dispatch = committed pos + in-flight
+        # lag (resynced from the lanes on every entry)
+        pl_pos = {i: lane.pos for i, lane in live.items()}
+        feed = np.zeros(engine.n_lanes, np.int32)
+        for i, lane in live.items():
+            feed[i] = lane.next_token
+        meta: deque = deque()  # live-lane ids at each dispatch, oldest first
+        host_feed = True  # first dispatch reseeds the chain from host tokens
+        dispatched_any = False
+        spec_k = (
+            getattr(engine, "SPEC_DRAFT", 0)
+            if self.speculative and getattr(engine, "supports_speculative", False)
+            else 0
+        )
+        seq_len = engine.config.seq_len
+        while True:
+            flush = self._stop.is_set() or not live or not self.queue.empty()
+            if not flush and spec_k > 0:
+                flush = any(
+                    lane.request.temperature == 0.0
+                    and seq_len - lane.pos - 1 > 0
+                    and lane.drafter.draft(lane.next_token, spec_k)
+                    for lane in live.values()
+                )
+            while not flush and engine.pipeline_inflight() < depth:
+                self._pipeline_dispatch(
+                    live, pl_pos, feed if host_feed else None
+                )
+                host_feed = False
+                dispatched_any = True
+                meta.append(tuple(live))
+                for i in live:
+                    pl_pos[i] += 1
+            if engine.pipeline_inflight() == 0:
+                break
+            self._pipeline_consume(live, meta.popleft())
+        if live and dispatched_any:
+            # cut short with lanes still generating: an actual flush (the
+            # natural all-lanes-finished drain is not)
+            with engine.stats.lock:
+                engine.stats.pipeline_flushes += 1
+        engine.pipeline_flush()  # ring already drained; drops the carry
+
     def _finish(self, lane_idx: int, req: Request, reason: str = "stop") -> None:
         req.state = RequestState.DONE
         req.finish_reason = reason
@@ -646,6 +800,9 @@ class ContinuousBatchingScheduler:
                 # `self._stop` at 1ms as earlier revisions did.
                 continue
 
+            host_exact_active = any(
+                l.host_exact and l.request.temperature > 0 for _, l in active
+            )
             tokens = np.zeros(n_lanes, np.int32)
             # EVERY lane gets a KV write from this decode step (one compiled
             # program, all lanes scatter). Idle/finished lanes point at
@@ -657,7 +814,7 @@ class ContinuousBatchingScheduler:
             # rewrites before any query can read it.
             positions = np.full(n_lanes, cfg.seq_len, np.int32)
             temps = np.zeros(n_lanes, np.float32)
-            topps = np.full(n_lanes, 0.9, np.float32)
+            topps = np.full(n_lanes, DEFAULT_TOPP, np.float32)
             seeds = np.zeros(n_lanes, np.uint32)
             for i, lane in enumerate(self._lanes):
                 if lane.request is not None and lane.pending:
@@ -695,6 +852,14 @@ class ContinuousBatchingScheduler:
                 if not draft_len.any():
                     draft_len = None  # nothing to verify: plain step
 
+            if draft_len is None and self._pipeline_ok(active, prefilled):
+                # steady state with no drafts to verify: the pipelined path
+                # overlaps step k's host consume with step k+1's device
+                # execution (device-fed token carry, lagged readback) until
+                # an admission / draft / stop forces a flush
+                self._run_pipelined(active)
+                continue
+
             chosen = None
             h = 0 if draft_len is not None else self._multi_horizon(
                 active, prefilled
@@ -709,17 +874,19 @@ class ContinuousBatchingScheduler:
                     tokens, positions, temps, topps, seeds, h
                 )
             else:
+                # logits materialize only when a host-exact lane will read
+                # them: the common all-device-sampling step keeps no
+                # [n_lanes, vocab] buffer alive
                 logits, greedy, sampled = self.engine.decode(
-                    tokens, positions, temps, topps, seeds
+                    tokens, positions, temps, topps, seeds,
+                    want_logits=host_exact_active,
                 )
             # host-exact lanes (global host_sampling mode, or per-request
             # fallback for near-1.0 top-p / very high temperature where the
             # device sampler's top-k truncation would distort): one batched
             # [n_lanes, vocab] transfer; pure on-device batches: tokens only
             logits_np = None
-            if any(
-                l.host_exact and l.request.temperature > 0 for _, l in active
-            ):
+            if host_exact_active:
                 # dlint: ok[host-sync] host-exact lanes only: ONE batched [n,vocab] f32 transfer, counted by all_logits
                 logits_np = self.engine.all_logits(logits)
 

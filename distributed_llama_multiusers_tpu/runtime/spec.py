@@ -109,6 +109,15 @@ class SpecStream:
             for t in tokens:
                 self.drafter.append(int(t))
 
+    def flush_pipeline(self) -> None:
+        """Flush any live async-decode chain before a direct engine call:
+        SpecStream's spec/multi/plain steps thread the same KV cache, and a
+        device-fed chain still in flight would keep feeding tokens from a
+        history this stream has moved past. No-op on engines without the
+        pipelined family or with nothing in flight."""
+        if getattr(self.engine, "pipeline_active", False):
+            self.engine.pipeline_flush()
+
     def advance(self, cur: int, pos: int):
         """Commit ``cur`` at ``pos`` and return ``(next_token, used_forward)``.
         used_forward=False means the token came from the pending lookahead
@@ -125,6 +134,7 @@ class SpecStream:
                 with stats.lock:
                     stats.spec_emitted += 1  # lookahead token consumed NOW
             return self.pending.pop(0), False
+        self.flush_pipeline()  # about to touch the engine directly
         draft: list[int] = []
         if self.drafter is not None:
             d_max = min(self.spec_k, self.config.seq_len - pos - 1)

@@ -155,6 +155,17 @@ class ApiServer:
             # multi-step horizons taken (each = several decode steps in one
             # device dispatch; decode_steps counts the chained steps)
             "multi_dispatches": stats["multi_dispatches"],
+            # async decode pipeline: host consume time hidden behind device
+            # execution, steps dispatched device-fed, chains aborted before
+            # their lanes finished, and ring occupancy right after each
+            # dispatch (how deep the overlap actually ran)
+            "overlap_s": round(stats["overlap_s"], 3),
+            "pipeline_dispatches": stats["pipeline_dispatches"],
+            "pipeline_flushes": stats["pipeline_flushes"],
+            "pipeline_depth_hist": {
+                str(k): v
+                for k, v in sorted(stats["pipeline_depth_hist"].items())
+            },
             "prefix_hits": stats["prefix_hits"],
             "prefix_tokens_saved": stats["prefix_tokens_saved"],
             "lanes_total": total,

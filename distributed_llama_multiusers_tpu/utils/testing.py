@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 
 def force_cpu_mesh(n_devices: int = 8) -> None:
@@ -51,6 +52,156 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
             plugins.add("tpu")
     except ImportError:
         pass
+
+
+class StubStreamTokenizer:
+    """Minimal stream-decoder tokenizer for scheduler-only harnesses (the
+    measurement/assertion is the scheduler loop, not BPE). EOS id =
+    vocab_size, never produced, so requests run to max_tokens."""
+
+    class _Vocab:  # TokenizerChatStops renders eos pieces from .vocab
+        def __getitem__(self, i) -> bytes:
+            return b"</s>"
+
+    def __init__(self, vocab_size: int = 64, prompt_tokens: int = 8):
+        self.vocab_size = vocab_size
+        self.prompt_tokens = prompt_tokens
+        self.eos_token_ids = [vocab_size]
+        self.chat_template = None
+        self.bos_id = 1
+        self.vocab = self._Vocab()
+
+    def encode(self, text, add_bos=True, add_special_tokens=True):
+        n = max(1, min(len(text), self.prompt_tokens))
+        return [(7 + i) % self.vocab_size for i in range(n)]
+
+    def make_stream_decoder(self):
+        return self
+
+    def decode(self, token):  # stream-decoder protocol
+        return "x"
+
+
+class MockAsyncEngine:
+    """Engine stub modelling an ASYNC device for scheduler pipeline tests
+    and the bench microbench: dispatch is free and advances a simulated
+    device busy-until timeline, consume blocks until the simulated step
+    completes. The scheduler's pipelined loop runs against it unmodified,
+    so the ``events`` log proves the lag structure (consume of step k runs
+    while step k+1 is already dispatched) without accelerator timing noise.
+    One implementation, imported by both tests/test_pipelined_decode.py and
+    bench.py, so the pinned test and the bench evidence cannot drift."""
+
+    supports_multi_step = False
+    supports_speculative = False
+    supports_pipelined = True
+
+    def __init__(self, n_lanes=4, vocab=64, seq_len=4096, step_s=0.002,
+                 pipeline_depth=2):
+        import types
+
+        from ..runtime.engine import EngineStats
+
+        self.n_lanes = n_lanes
+        self.config = types.SimpleNamespace(seq_len=seq_len, vocab_size=vocab)
+        self.stats = EngineStats()
+        self.pipeline_depth = pipeline_depth
+        self.step_s = step_s
+        self._free_at = 0.0  # simulated device busy-until timestamp
+        self._ring = []  # (ready_at, dispatched_at, step_idx)
+        self._carry_live = False
+        self._steps = 0
+        self.events = []  # ("dispatch"|"consume", step_idx)
+
+    def max_chunk(self):
+        return 16
+
+    def reset_lane(self, lane):
+        pass
+
+    def prefill_chunk(self, lane, chunk, start_pos, temp=0.0, topp=0.9, seed=0):
+        return None, 1, 1
+
+    def _toks(self, step):
+        import numpy as np
+
+        return np.asarray(
+            [2 + (step * 7 + i) % (self.config.vocab_size - 2)
+             for i in range(self.n_lanes)],
+            np.int32,
+        )
+
+    def decode(self, tokens, positions, temps=None, topps=None, seeds=None,
+               want_logits=True):
+        # synchronous fallback (admission iterations): dispatch + block
+        now = time.monotonic()
+        self._free_at = max(now, self._free_at) + self.step_s
+        time.sleep(max(0.0, self._free_at - now))
+        s = self._steps
+        self._steps += 1
+        with self.stats.lock:
+            self.stats.decode_steps += 1
+        t = self._toks(s)
+        return None, t, t
+
+    def pipeline_inflight(self):
+        return len(self._ring)
+
+    @property
+    def pipeline_active(self):
+        return bool(self._ring) or self._carry_live
+
+    def decode_pipelined(self, positions, temps=None, topps=None, seeds=None,
+                         tokens=None):
+        now = time.monotonic()
+        self._free_at = max(now, self._free_at) + self.step_s
+        s = self._steps
+        self._steps += 1
+        self._ring.append((self._free_at, now, s))
+        self._carry_live = True
+        self.events.append(("dispatch", s))
+        with self.stats.lock:
+            self.stats.pipeline_dispatches += 1
+            d = len(self._ring)
+            self.stats.pipeline_depth_hist[d] = (
+                self.stats.pipeline_depth_hist.get(d, 0) + 1
+            )
+
+    def pipeline_consume(self):
+        ready_at, dispatched_at, s = self._ring.pop(0)
+        t0 = time.monotonic()
+        time.sleep(max(0.0, ready_at - t0))
+        self.events.append(("consume", s))
+        with self.stats.lock:
+            self.stats.decode_steps += 1
+            self.stats.decode_s += max(0.0, ready_at - t0)
+            self.stats.overlap_s += max(0.0, t0 - dispatched_at)
+        t = self._toks(s)
+        return t, t
+
+    def pipeline_flush(self, count=True):
+        n = len(self._ring)
+        while self._ring:
+            self.pipeline_consume()
+        self._carry_live = False
+        if n and count:
+            with self.stats.lock:
+                self.stats.pipeline_flushes += 1
+        return n
+
+    def count_overlapped_consumes(self):
+        """(consumed steps, consumes of step k that happened after step k+1
+        was already dispatched) — the one-step-lag evidence."""
+        seen = set()
+        consumed = overlapped = 0
+        for kind, s in self.events:
+            if kind == "dispatch":
+                seen.add(s)
+            else:
+                consumed += 1
+                if s + 1 in seen:
+                    overlapped += 1
+        return consumed, overlapped
 
 
 def greedy_rollout(engine, prompt, n):

@@ -265,6 +265,79 @@ def test_host_sync_implicit_bool_on_compiled_step_output(tmp_path):
     assert "implicit bool" in findings[0].message
 
 
+# -- pipeline-sync -----------------------------------------------------------
+
+
+def test_pipeline_sync_flags_sync_in_dispatch_half(tmp_path):
+    """Acceptance-criterion demo: a host-sync construct inside the
+    pipelined dispatch half is a finding (on top of the file-wide host-sync
+    rule) — the dispatch half must enqueue device work from host metadata
+    only, or the async chain silently re-serializes."""
+    findings = run_on(tmp_path, {"runtime/scheduler.py": """
+        import numpy as np
+
+        class Sched:
+            def _pipeline_dispatch(self, live, pl_pos, feed):
+                arr = np.asarray(feed)
+                self.engine.decode_pipelined(arr)
+    """})
+    assert "pipeline-sync" in checks_of(findings)
+    # the same sync OUTSIDE the dispatch half is host-sync's business only
+    other = run_on(tmp_path / "other", {"runtime/scheduler.py": """
+        import numpy as np
+
+        class Sched:
+            def _pipeline_consume(self, live):
+                # dlint: ok[host-sync] the lagged per-step readback
+                return np.asarray(self.engine.pipeline_consume())
+    """})
+    assert "pipeline-sync" not in checks_of(other)
+
+
+def test_pipeline_sync_clean_dispatch_half(tmp_path):
+    """Building host metadata arrays and dispatching is exactly what the
+    dispatch half is for — no findings."""
+    findings = run_on(tmp_path, {"runtime/scheduler.py": """
+        import numpy as np
+
+        class Sched:
+            def _pipeline_dispatch(self, live, pl_pos, feed):
+                positions = np.full(4, 128, np.int32)
+                for i, lane in live.items():
+                    positions[i] = pl_pos[i]
+                self.engine.decode_pipelined(positions, tokens=feed)
+    """})
+    assert findings == []
+
+
+def test_pipeline_sync_implicit_bool_and_cast(tmp_path):
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        class E:
+            def decode_pipelined(self, positions, tokens=None):
+                nxt, packed, self.cache = self._decode_pl_fn(positions)
+                if nxt:
+                    return int(packed)
+                return None
+    """})
+    pipeline = [f for f in findings if f.check == "pipeline-sync"]
+    msgs = " ".join(f.message for f in pipeline)
+    assert "implicit bool" in msgs and "cast" in msgs
+
+
+def test_pipeline_sync_waiver_suppresses(tmp_path):
+    """A waiver naming BOTH overlapping checks silences the line (host-sync
+    also scopes these files)."""
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        import numpy as np
+
+        class E:
+            def decode_pipelined(self, positions, tokens=None):
+                # dlint: ok[host-sync, pipeline-sync] probe build: deliberate sync
+                return np.asarray(positions)
+    """})
+    assert findings == []
+
+
 # -- clock -------------------------------------------------------------------
 
 

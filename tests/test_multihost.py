@@ -369,7 +369,7 @@ class _ScriptedEngine:
         self.calls = 0
         self.fail_on = set(fail_on)
 
-    def decode(self, *a):
+    def decode(self, *a, want_logits=True):
         self.calls += 1
         if self.calls in self.fail_on:
             raise RuntimeError(f"transient #{self.calls}")

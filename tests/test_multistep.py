@@ -83,9 +83,12 @@ def test_decode_multi_matches_single_steps(loaded):
 
 def _run_requests(config, params, tok, reqs_spec, multi_step, n_lanes=2):
     engine = _fresh_engine(config, params, n_lanes=n_lanes)
+    # pipelined=False isolates the multi-step horizon (the pipelined path
+    # would otherwise win the steady-state gate; its own stream-identity
+    # tests live in test_pipelined_decode.py)
     sched = ContinuousBatchingScheduler(
         engine, tok, speculative=False, prefix_min_tokens=0,
-        multi_step=multi_step,
+        multi_step=multi_step, pipelined=False,
     )
     reqs = [
         Request(prompt=p, max_tokens=m, temperature=t, seed=s)
@@ -135,7 +138,7 @@ def test_multi_step_overshoot_does_not_corrupt_prefix_reuse(loaded):
         engine = _fresh_engine(config, params, n_lanes=2)
         sched = ContinuousBatchingScheduler(
             engine, tok, speculative=False, prefix_min_tokens=prefix_min,
-            multi_step=multi_step,
+            multi_step=multi_step, pipelined=False,
         )
         sched.start()
         try:

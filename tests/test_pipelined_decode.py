@@ -1,0 +1,623 @@
+"""Async decode pipeline (``engine.decode_pipelined`` + the scheduler's
+dispatch/consume halves).
+
+The serving invariant under test is STREAM IDENTITY: the pipelined path —
+step k+1 dispatched from the on-device token carry while step k's host
+readback runs one step behind — must emit byte-identical token streams to
+the synchronous path, for greedy AND device-sampled lanes, including a
+stop string that lands while steps are in flight (the junk-KV discard
+rule) and a mid-stream cancel. Plus the overlap mechanics themselves,
+pinned deterministically against a mocked async engine (real-engine CPU
+timings are too noisy to prove a lag structure).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from distributed_llama_multiusers_tpu.formats import load_model_header
+from distributed_llama_multiusers_tpu.models import load_params_from_m
+from distributed_llama_multiusers_tpu.runtime import (
+    ContinuousBatchingScheduler,
+    InferenceEngine,
+    Request,
+)
+from distributed_llama_multiusers_tpu.runtime.engine import (
+    DEFAULT_PIPELINE_DEPTH,
+    DEFAULT_TOPP,
+    EngineStats,
+    warmup_engine,
+)
+from distributed_llama_multiusers_tpu.tokenizer import Tokenizer
+from distributed_llama_multiusers_tpu.utils.testing import (
+    MockAsyncEngine,
+    StubStreamTokenizer,
+)
+
+
+@pytest.fixture(scope="module")
+def loaded(tiny_model):
+    h = load_model_header(tiny_model["model"])
+    config, params = load_params_from_m(tiny_model["model"], h, dtype=jnp.float32)
+    tok = Tokenizer(tiny_model["tokenizer"])
+    return config, params, tok
+
+
+def _fresh_engine(config, params, n_lanes=2, **kw):
+    return InferenceEngine(
+        config, params, n_lanes=n_lanes, prefill_buckets=(4,), **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# engine level: the device-fed chain equals single stepping
+# ---------------------------------------------------------------------------
+
+
+def test_engine_pipelined_matches_single_steps(loaded):
+    """A pipelined chain (depth-2 ring, device token carry) emits exactly
+    the tokens the synchronous decode loop would, for a greedy lane and a
+    seeded device-sampled lane together — same fold_in(seed, pos) draws."""
+    config, params, _ = loaded
+    prompt = [5, 9, 3]
+    temps = np.asarray([0.0, 0.8], np.float32)
+    topps = np.full(2, DEFAULT_TOPP, np.float32)
+    seeds = np.asarray([0, 123], np.uint32)
+
+    def sync_chain(engine, n_steps):
+        _, g0, pos = engine.prefill(0, prompt)
+        _, g1, _ = engine.prefill(1, prompt)
+        toks = np.asarray([g0, g1], np.int32)
+        positions = np.asarray([pos, pos], np.int32)
+        out = []
+        for _ in range(n_steps):
+            _, greedy, sampled = engine.decode(toks, positions, temps, topps, seeds)
+            toks = np.where(temps == 0.0, greedy, sampled).astype(np.int32)
+            out.append(toks.copy())
+            positions = positions + 1
+        return np.stack(out)
+
+    def pipelined_chain(engine, n_steps):
+        _, g0, pos = engine.prefill(0, prompt)
+        _, g1, _ = engine.prefill(1, prompt)
+        toks = np.asarray([g0, g1], np.int32)
+        positions = np.asarray([pos, pos], np.int32)
+        out = []
+        first = True
+        dispatched = 0
+        while len(out) < n_steps:
+            while dispatched - len(out) < engine.pipeline_depth and dispatched < n_steps:
+                engine.decode_pipelined(
+                    positions, temps, topps, seeds,
+                    tokens=toks if first else None,
+                )
+                first = False
+                dispatched += 1
+                positions = positions + 1
+            greedy, sampled = engine.pipeline_consume()
+            out.append(np.where(temps == 0.0, greedy, sampled).astype(np.int32))
+        engine.pipeline_flush()
+        return np.stack(out)
+
+    single = sync_chain(_fresh_engine(config, params), 8)
+    multi = pipelined_chain(_fresh_engine(config, params), 8)
+    np.testing.assert_array_equal(single, multi)
+
+
+def test_engine_pipeline_ring_discipline(loaded):
+    """The in-flight ring is bounded at pipeline_depth; consume without a
+    dispatch and a carry-less device-fed dispatch are caller bugs; flush
+    counts only when it actually discards in-flight steps."""
+    config, params, _ = loaded
+    engine = _fresh_engine(config, params, pipeline_depth=2)
+    z = np.zeros(2, np.int32)
+    with pytest.raises(RuntimeError, match="carry"):
+        engine.decode_pipelined(z)  # no chain seeded yet
+    with pytest.raises(RuntimeError, match="empty"):
+        engine.pipeline_consume()
+    engine.decode_pipelined(z, tokens=z)
+    engine.decode_pipelined(z)
+    assert engine.pipeline_inflight() == 2
+    with pytest.raises(RuntimeError, match="ring full"):
+        engine.decode_pipelined(z)
+    assert engine.pipeline_active
+    assert engine.pipeline_flush() == 2  # discarded two in-flight steps
+    assert not engine.pipeline_active
+    snap = engine.stats.snapshot()
+    assert snap["pipeline_dispatches"] == 2
+    assert snap["pipeline_flushes"] == 1  # the discarding flush counted
+    assert snap["pipeline_depth_hist"] == {1: 1, 2: 1}
+    assert engine.pipeline_flush() == 0  # nothing in flight: not a flush
+    assert engine.stats.snapshot()["pipeline_flushes"] == 1
+
+
+def test_decode_want_logits_gate(loaded):
+    """want_logits=False (the common all-device-sampling step) returns the
+    same tokens without materializing the [n, vocab] logits output."""
+    config, params, _ = loaded
+    e1 = _fresh_engine(config, params)
+    e2 = _fresh_engine(config, params)
+    z = np.zeros(2, np.int32)
+    logits, g1, s1 = e1.decode(z, z)
+    none, g2, s2 = e2.decode(z, z, want_logits=False)
+    assert logits is not None and none is None
+    np.testing.assert_array_equal(g1, g2)
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_default_topp_single_source(loaded):
+    """Satellite: the top-p default is one constant — Request and the
+    engine wrappers cannot desync."""
+    assert Request(prompt="x").topp == DEFAULT_TOPP
+    config, params, _ = loaded
+    engine = _fresh_engine(config, params)
+    # defaulted topps must equal explicitly passing DEFAULT_TOPP
+    z = np.zeros(2, np.int32)
+    t = np.asarray([0.0, 0.9], np.float32)
+    seeds = np.asarray([1, 2], np.uint32)
+    _, _, s_default = engine.decode(z, z, t, None, seeds)
+    _, _, s_explicit = _fresh_engine(config, params).decode(
+        z, z, t, np.full(2, DEFAULT_TOPP, np.float32), seeds
+    )
+    np.testing.assert_array_equal(s_default, s_explicit)
+
+
+def test_warmup_covers_horizon_set_and_pipeline(loaded):
+    """Satellite: warmup compiles every multi-step horizon bucket the
+    scheduler can pick (not just the top one) and the pipelined step, so
+    none of them charges first-request latency mid-service."""
+    config, params, _ = loaded
+    engine = _fresh_engine(config, params)
+    warmup_engine(engine, spec=True, multi_step=8)
+    assert sorted(engine._decode_multi_fns) == [2, 4, 8]
+    # the pipelined chain ran and was flushed back to idle, and warmup
+    # left no trace in the serving counters
+    assert not engine.pipeline_active
+    snap = engine.stats.snapshot()
+    assert snap["pipeline_dispatches"] == 0 and snap["decode_steps"] == 0
+
+
+# ---------------------------------------------------------------------------
+# scheduler level: stream identity pipelined vs synchronous
+# ---------------------------------------------------------------------------
+
+
+def _run_requests(config, params, tok, reqs, pipelined, n_lanes=2, **kw):
+    engine = _fresh_engine(config, params, n_lanes=n_lanes)
+    kw.setdefault("speculative", False)
+    sched = ContinuousBatchingScheduler(
+        engine, tok, prefix_min_tokens=0, multi_step=0,
+        pipelined=pipelined, **kw,
+    )
+    sched.start()
+    try:
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            r.future.result(timeout=300)
+    finally:
+        sched.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    return [list(r.generated_tokens) for r in reqs], engine.stats.snapshot()
+
+
+def test_scheduler_pipelined_stream_identity(loaded):
+    """The serving loop with pipelining produces EXACTLY the synchronous
+    token streams — greedy and seeded device-sampled lanes, different
+    max_tokens so one lane finishes while steps for it are still in
+    flight (its junk columns are discarded)."""
+    config, params, tok = loaded
+
+    def reqs():
+        return [
+            Request(prompt="hello world", max_tokens=13, temperature=0.0),
+            Request(prompt="other prompt", max_tokens=24, temperature=0.8,
+                    seed=42),
+        ]
+
+    base, base_stats = _run_requests(config, params, tok, reqs(), pipelined=False)
+    pl, stats = _run_requests(config, params, tok, reqs(), pipelined=True)
+    assert pl == base
+    assert stats["pipeline_dispatches"] > 0  # the pipeline actually engaged
+    assert stats["overlap_s"] > 0  # consume ran behind a live dispatch
+    assert base_stats["pipeline_dispatches"] == 0
+    # steady-state decode: chains ended by lane completion, never aborted
+    assert stats["pipeline_flushes"] == 0
+
+
+def test_scheduler_pipelined_stop_string_mid_flight(loaded):
+    """An EOS (stop string) that lands while a step is in flight: the
+    consume half discovers it one step late, the in-flight junk for that
+    lane is discarded, and the emitted stream is byte-identical to the
+    synchronous path's."""
+    config, params, tok = loaded
+    # probe run: derive a stop string from a MID-STREAM token piece of the
+    # text an unconstrained greedy run actually produces (the detector's
+    # window anchors near piece boundaries, so the stop must align to one)
+    probe = Request(prompt="hello world", max_tokens=24, temperature=0.0)
+    _run_requests(config, params, tok, [probe], pipelined=False)
+    dec = tok.make_stream_decoder()
+    pieces = [dec.decode(t) for t in probe.generated_tokens]
+    stop = next(
+        (p for i, p in enumerate(pieces)
+         if 3 <= i <= len(pieces) - 6 and p and p.strip()),
+        None,
+    )
+    assert stop is not None, f"no usable mid-stream piece in {pieces!r}"
+
+    def stopped():
+        return [Request(prompt="hello world", max_tokens=24, temperature=0.0,
+                        stop=[stop])]
+
+    base, base_stats = _run_requests(config, params, tok, stopped(), pipelined=False)
+    pl_reqs = stopped()
+    pl, stats = _run_requests(config, params, tok, pl_reqs, pipelined=True)
+    assert pl == base
+    assert pl_reqs[0].finish_reason == "stop"
+    assert len(pl[0]) < 24  # the stop really fired
+    assert stats["pipeline_dispatches"] > 0
+    # the junk-KV discard path ran: the pipelined run executed MORE decode
+    # steps than it emitted tokens (the in-flight step past the stop ran
+    # with a junk feed and was discarded), while the sync run stepped
+    # exactly once per token
+    assert base_stats["decode_steps"] == len(base[0])
+    assert stats["decode_steps"] > len(pl[0])
+
+
+def test_scheduler_pipelined_cancel_mid_stream(loaded):
+    """A cancel() while steps are in flight: the lane resolves as
+    cancelled with a PREFIX of the synchronous stream, and the other lane's
+    stream is untouched."""
+    config, params, tok = loaded
+    base, _ = _run_requests(
+        config, params, tok,
+        [Request(prompt="hello world", max_tokens=40, temperature=0.0),
+         Request(prompt="other prompt", max_tokens=16, temperature=0.8,
+                 seed=7)],
+        pipelined=False,
+    )
+
+    deltas = []
+    victim = Request(prompt="hello world", max_tokens=40, temperature=0.0)
+
+    def on_delta(piece):
+        deltas.append(piece)
+        if len(deltas) == 3:
+            victim.cancel()
+
+    victim.on_delta = on_delta
+    other = Request(prompt="other prompt", max_tokens=16, temperature=0.8,
+                    seed=7)
+    pl, _ = _run_requests(config, params, tok, [victim, other], pipelined=True)
+    assert victim.finish_reason == "cancelled"
+    assert len(pl[0]) < 40  # actually cut short
+    assert pl[0] == base[0][: len(pl[0])]  # prefix of the sync stream
+    assert pl[1] == base[1]  # the surviving lane is byte-identical
+
+
+def test_scheduler_pipelined_with_speculation(loaded):
+    """speculative=True: drafts force a pipeline flush and the spec path
+    runs (it wins steady-state greedy); streams still match the
+    non-pipelined scheduler exactly."""
+    config, params, tok = loaded
+
+    def reqs():
+        return [
+            Request(prompt="aa bb aa bb aa", max_tokens=12, temperature=0.0),
+            Request(prompt="sampled one", max_tokens=8, temperature=0.8,
+                    seed=123),
+        ]
+
+    base, _ = _run_requests(
+        config, params, tok, reqs(), pipelined=False, speculative=True
+    )
+    pl, stats = _run_requests(
+        config, params, tok, reqs(), pipelined=True, speculative=True
+    )
+    assert pl == base
+    assert stats["spec_steps"] > 0  # speculation still engaged
+
+
+def test_host_exact_lane_disables_pipeline(loaded):
+    """A host-exact sampling lane (top_p >= 0.99 fallback) reads full
+    logits every step: the gate must keep the whole batch on the
+    synchronous path."""
+    config, params, tok = loaded
+
+    def reqs():
+        return [Request(prompt="hello", max_tokens=6, temperature=0.8,
+                        topp=1.0, seed=3)]
+
+    base, _ = _run_requests(config, params, tok, reqs(), pipelined=False)
+    out, stats = _run_requests(config, params, tok, reqs(), pipelined=True)
+    assert out == base  # bit-exact host sampler stream either way
+    assert len(out[0]) >= 1
+    assert stats["pipeline_dispatches"] == 0  # gate kept the sync path
+
+
+def test_pipelined_overshoot_does_not_corrupt_prefix_reuse(loaded):
+    """Junk-KV invariant: a lane that finished while pipelined steps were
+    in flight holds junk KV past its consumed tokens; a later request
+    prefix-reusing that lane must still decode the cold-prefill stream."""
+    config, params, tok = loaded
+    prompt = "shared prefix for reuse "
+
+    def run(prefix_min, pipelined):
+        engine = _fresh_engine(config, params, n_lanes=2)
+        sched = ContinuousBatchingScheduler(
+            engine, tok, speculative=False, prefix_min_tokens=prefix_min,
+            multi_step=0, pipelined=pipelined,
+        )
+        sched.start()
+        try:
+            a = sched.submit(Request(prompt=prompt, max_tokens=9))
+            a.future.result(timeout=300)
+            b = sched.submit(Request(prompt=prompt, max_tokens=16))
+            b.future.result(timeout=300)
+        finally:
+            sched.stop()
+        assert a.error is None and b.error is None
+        snap = engine.stats.snapshot()
+        return list(b.generated_tokens), snap["prefix_hits"]
+
+    cold, _ = run(prefix_min=0, pipelined=True)
+    warm, hits = run(prefix_min=4, pipelined=True)
+    assert hits >= 1  # the second request actually reused lane KV
+    assert warm == cold
+
+
+# ---------------------------------------------------------------------------
+# EngineStats hygiene for the new counters
+# ---------------------------------------------------------------------------
+
+
+def test_stats_depth_hist_snapshot_isolation():
+    s = EngineStats()
+    with s.lock:
+        s.pipeline_depth_hist[2] = 5
+    snap = s.snapshot()
+    with s.lock:
+        s.pipeline_depth_hist[2] = 99
+    assert snap["pipeline_depth_hist"] == {2: 5}  # copy, not alias
+    reset_snap = s.reset()
+    assert reset_snap.pipeline_depth_hist == {2: 99}
+    with s.lock:
+        assert s.pipeline_depth_hist == {}
+
+
+# ---------------------------------------------------------------------------
+# mocked async engine: the overlap structure itself, deterministically
+# ---------------------------------------------------------------------------
+
+
+def _drive(engine, reqs, **kw):
+    sched = ContinuousBatchingScheduler(
+        engine, StubStreamTokenizer(engine.config.vocab_size),
+        speculative=False, prefix_min_tokens=0, multi_step=0, **kw,
+    )
+    sched.start()
+    try:
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            r.future.result(timeout=60)
+    finally:
+        sched.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+
+
+def test_mocked_scheduler_overlaps_consume_with_dispatch():
+    """Acceptance microbench: in steady-state decode the consume of step k
+    happens after step k+1 was dispatched (one-step lag) and no chain is
+    ever aborted (pipeline_flushes == 0)."""
+    engine = MockAsyncEngine(n_lanes=2)
+    _drive(engine, [
+        Request(prompt="a", max_tokens=32, temperature=0.0),
+        Request(prompt="b", max_tokens=32, temperature=0.0),
+    ])
+    consumed, overlapped = engine.count_overlapped_consumes()
+    assert consumed >= 30
+    # all but the chain-final consumes ran behind a younger dispatch
+    assert overlapped >= consumed - 2, engine.events
+    snap = engine.stats.snapshot()
+    assert snap["pipeline_flushes"] == 0
+    assert snap["overlap_s"] > 0
+
+
+def test_mocked_scheduler_admission_forces_flush():
+    """A queued admission mid-chain exits the pipelined mode (counted as a
+    flush) and the sync loop admits; the chain then re-forms."""
+    engine = MockAsyncEngine(n_lanes=2, step_s=0.005)
+    first = Request(prompt="a", max_tokens=200, temperature=0.0)
+    second = Request(prompt="b", max_tokens=8, temperature=0.0)
+    sched = ContinuousBatchingScheduler(
+        engine, StubStreamTokenizer(engine.config.vocab_size),
+        speculative=False, prefix_min_tokens=0, multi_step=0,
+    )
+    sched.start()
+    try:
+        sched.submit(first)
+        # wait until the pipelined chain is demonstrably running
+        deadline = time.monotonic() + 30
+        while engine.stats.snapshot()["pipeline_dispatches"] < 4:
+            assert time.monotonic() < deadline, "pipeline never engaged"
+            time.sleep(0.005)
+        sched.submit(second)
+        second.future.result(timeout=60)
+        first.future.result(timeout=60)
+    finally:
+        sched.stop()
+    assert first.error is None and second.error is None
+    assert len(second.generated_tokens) == 8
+    snap = engine.stats.snapshot()
+    assert snap["pipeline_flushes"] >= 1  # the admission cut a chain short
+
+
+# ---------------------------------------------------------------------------
+# SpecStream flush hook
+# ---------------------------------------------------------------------------
+
+
+def test_specstream_flushes_live_pipeline(loaded):
+    """SpecStream.advance must flush a live device-fed chain before its own
+    direct engine dispatch (they thread the same cache)."""
+    from distributed_llama_multiusers_tpu.runtime.spec import SpecStream
+
+    config, params, _ = loaded
+    engine = _fresh_engine(config, params)
+    _, g0, pos = engine.prefill(0, [5, 9, 3])
+    # leave a chain active, as a buggy caller might
+    z = np.zeros(2, np.int32)
+    engine.decode_pipelined(z, tokens=z)
+    assert engine.pipeline_active
+    spec = SpecStream(engine, config, enabled=False)
+    nxt, used = spec.advance(int(g0), pos)
+    assert used and isinstance(nxt, int)
+    assert not engine.pipeline_active  # flushed before the direct decode
+
+
+# ---------------------------------------------------------------------------
+# pod control plane: OP_DECODE_PIPELINED replay
+# ---------------------------------------------------------------------------
+
+
+def test_pod_packet_replays_decode_pipelined():
+    """OP_DECODE_PIPELINED round-trips the feed flag, ring depth, and all
+    operand arrays through the control-plane packet into the worker's
+    pipelined engine calls — including the flush-then-reseed on a host-fed
+    packet."""
+    from distributed_llama_multiusers_tpu.parallel import multihost as mh
+
+    calls = []
+
+    class _Eng:
+        n_lanes = 2
+        SPEC_DRAFT = 3
+        pipeline_depth = 2
+
+        def __init__(self):
+            self._ring = 0
+
+        def pipeline_inflight(self):
+            return self._ring
+
+        def pipeline_consume(self):
+            calls.append(("consume",))
+            self._ring -= 1
+
+        def pipeline_flush(self, count=True):
+            # worker-side flushes must never count as aborts (count=False)
+            assert count is False
+            calls.append(("flush", self._ring))
+            self._ring = 0
+
+        def decode_pipelined(self, positions, temps=None, topps=None,
+                             seeds=None, tokens=None):
+            self._ring += 1
+            calls.append((
+                "dispatch",
+                None if tokens is None else np.asarray(tokens).tolist(),
+                np.asarray(positions).tolist(),
+                np.asarray(temps).tolist(),
+                np.asarray(seeds).tolist(),
+            ))
+
+    sent = []
+
+    class _Plane(mh.ControlPlane):
+        def __init__(self):
+            super().__init__(n_lanes=2, chunk=8)
+
+        def _bcast(self, pkt):
+            sent.append(pkt.copy())
+            return pkt
+
+    plane = _Plane()
+    temps = np.asarray([0.0, 0.8], np.float32)
+    topps = np.full(2, 0.9, np.float32)
+    seeds = np.asarray([1, 2], np.uint32)
+    # reseed (host-fed), then two device-fed continuations, then reseed
+    plane.send_decode_pipelined(
+        np.asarray([7, 9], np.int32), np.asarray([3, 4], np.int32),
+        temps, topps, seeds, depth=2,
+    )
+    for pos in ((4, 5), (5, 6)):
+        plane.send_decode_pipelined(
+            None, np.asarray(pos, np.int32), temps, topps, seeds, depth=2,
+        )
+    plane.send_decode_pipelined(
+        np.asarray([1, 2], np.int32), np.asarray([0, 0], np.int32),
+        temps, topps, seeds, depth=2,
+    )
+    # root ends the chain: workers must drain their own rings too
+    plane.send_pipeline_flush()
+    plane.send_stop()
+
+    replay = iter(sent)
+
+    class _ReplayPlane:
+        def recv(self):
+            return next(replay)
+
+        def slot(self, pkt, i, n):
+            return plane.slot(pkt, i, n)
+
+    mh.worker_loop(_Eng(), _ReplayPlane())
+    kinds = [c[0] for c in calls]
+    # host-fed -> flush+dispatch; device-fed -> dispatch; ring at depth 2
+    # before the third dispatch -> consume first; reseed -> flush again;
+    # the root's chain-end flush broadcast drains the worker ring last
+    assert kinds == ["flush", "dispatch", "dispatch", "consume", "dispatch",
+                     "flush", "dispatch", "flush"], calls
+    assert calls[-1] == ("flush", 1)  # the final dispatch was still ringed
+    first = calls[1]
+    assert first[1] == [7, 9] and first[2] == [3, 4] and first[4] == [1, 2]
+    assert calls[2][1] is None and calls[2][2] == [4, 5]
+    assert calls[-2][1] == [1, 2]  # the reseed dispatch carried host tokens
+
+
+def test_pod_packet_decode_want_logits_flag():
+    """The decode packet carries want_logits so every process dispatches
+    the same compiled program (logits vs no-logits are different HLO)."""
+    from distributed_llama_multiusers_tpu.parallel import multihost as mh
+
+    sent = []
+
+    class _Plane(mh.ControlPlane):
+        def __init__(self):
+            super().__init__(n_lanes=2, chunk=8)
+
+        def _bcast(self, pkt):
+            sent.append(pkt.copy())
+            return pkt
+
+    seen = []
+
+    class _Eng:
+        n_lanes = 2
+
+        def decode(self, tokens, positions, temps=None, topps=None,
+                   seeds=None, want_logits=True):
+            seen.append(want_logits)
+
+    plane = _Plane()
+    z = np.zeros(2, np.int32)
+    zf = np.zeros(2, np.float32)
+    plane.send_decode(z, z, zf, zf, z.view(np.uint32), want_logits=False)
+    plane.send_decode(z, z, zf, zf, z.view(np.uint32), want_logits=True)
+    plane.send_stop()
+
+    replay = iter(sent)
+
+    class _ReplayPlane:
+        def recv(self):
+            return next(replay)
+
+        def slot(self, pkt, i, n):
+            return plane.slot(pkt, i, n)
+
+    mh.worker_loop(_Eng(), _ReplayPlane())
+    assert seen == [False, True]

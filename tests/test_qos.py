@@ -267,9 +267,11 @@ def stack(tiny_model):
 
 def make_sched(engine, tok, **kw):
     # plain single-step decode: the slow_decode hold below must cover every
-    # decode path, and speculation/multi-step are covered elsewhere
+    # decode path, and speculation/multi-step/pipelining are covered
+    # elsewhere (the pipelined path would dispatch around the wrapped
+    # engine.decode and break the hold)
     return ContinuousBatchingScheduler(
-        engine, tok, speculative=False, multi_step=0, **kw
+        engine, tok, speculative=False, multi_step=0, pipelined=False, **kw
     )
 
 
