@@ -16,6 +16,11 @@ single phase can eat the budget:
                ContinuousBatchingScheduler at 8 concurrent requests (the
                reference's headline numbers are end-to-end app-loop
                per-token times, src/dllama.cpp:36-113)
+  serving_churn — Poisson arrivals against the real scheduler: TTFT
+               p50/p95 (submit -> first stream delta), aggregate tok/s,
+               and the pipeline flush count under churn — the stall-free
+               admission path (fused prefill+decode dispatch) keeps
+               flushes ~0 while requests join mid-chain
   ablations  — packed Q40 via XLA dequant, dense bf16 (what the kernel buys)
   8b         — the BASELINE north star: Llama-3.1-8B Q40 decode tok/s vs
                200 tok/s/chip (BASELINE.md), now on by default
@@ -642,6 +647,99 @@ def _phase_serving(config, small):
     }
 
 
+def _phase_serving_churn(config, small):
+    """Poisson-arrival churn against the REAL scheduler: requests join a
+    live serving loop mid-generation (the regime the fused prefill+decode
+    dispatch exists for) instead of arriving all up front like the
+    `serving` phase's batch. Reports TTFT p50/p95 measured submit -> first
+    stream delta, aggregate `serving_churn_tok_s`, and the pipeline flush
+    count — stall-free admissions keep it ~0 under churn (the remaining
+    flush conditions are drafts, host-exact lanes, and stop/drain; this
+    phase runs speculation off so admission behavior is what's measured).
+    CPU-smoke safe: small lane/request counts, deterministic seeded
+    arrivals."""
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.runtime.engine import warmup_engine
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+
+    n_lanes = 4 if small else 8
+    n_requests = 10 if small else 48
+    max_tokens = 10 if small else 48
+    params = _resident_packed_params(config)
+    engine = InferenceEngine(
+        config, params, n_lanes=n_lanes, prefill_buckets=(16,)
+    )
+    tokenizer = _BenchTokenizer(config.vocab_size)
+    sched = ContinuousBatchingScheduler(engine, tokenizer, speculative=False)
+    # compile everything (incl. the per-bucket fused family) OUTSIDE the
+    # measured window: TTFT under churn must not read as XLA compile time
+    warmup_engine(engine, spec=False, multi_step=sched.multi_step)
+
+    rng = np.random.default_rng(7)
+    intervals = rng.exponential(0.05, n_requests)
+    t_submit: dict[int, float] = {}
+    ttft: dict[int, float] = {}
+
+    def make_cb(req):
+        def cb(_delta):
+            if req.id not in ttft:
+                ttft[req.id] = time.perf_counter() - t_submit[req.id]
+        return cb
+
+    reqs = []
+    for i in range(n_requests):
+        r = Request(
+            prompt="churn benchmark prompt " * 2,
+            max_tokens=max_tokens,
+            temperature=0.0 if i % 2 == 0 else 0.8,
+            seed=200 + i,
+        )
+        r.on_delta = make_cb(r)
+        reqs.append(r)
+
+    sched.start()
+    t0 = time.perf_counter()
+    try:
+        for r, dt in zip(reqs, intervals):
+            time.sleep(dt)
+            t_submit[r.id] = time.perf_counter()
+            sched.submit(r)
+        for r in reqs:
+            r.future.result(timeout=600)
+    finally:
+        sched.stop()
+    wall = time.perf_counter() - t0
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    toks = sum(len(r.generated_tokens) for r in reqs)
+    stats = engine.stats.snapshot()
+    tt = np.sort(np.asarray([ttft[r.id] for r in reqs if r.id in ttft]))
+    return {
+        "serving_churn_tok_s": round(toks / wall, 2),
+        "serving_churn_requests": n_requests,
+        "serving_churn_lanes": n_lanes,
+        "serving_churn_ttft_ms_p50": (
+            round(float(tt[len(tt) // 2]) * 1e3, 1) if len(tt) else None
+        ),
+        "serving_churn_ttft_ms_p95": (
+            round(float(tt[int(len(tt) * 0.95)]) * 1e3, 1) if len(tt) else None
+        ),
+        # the headline churn evidence: admissions rode fused dispatches
+        # inside the live chain instead of flushing it
+        "serving_churn_pipeline_flushes": stats["pipeline_flushes"],
+        "serving_churn_fused_steps": stats["fused_steps"],
+        "serving_churn_pipeline_dispatches": stats["pipeline_dispatches"],
+        "serving_churn_admission_stall_s": round(
+            stats["admission_stall_s"], 4
+        ),
+        "serving_churn_prefix_hits": stats["prefix_hits"],
+    }
+
+
 def _pipeline_microbench(n_requests=4, max_tokens=48):
     """Drive the REAL scheduler loop over the mocked async engine
     (utils.testing.MockAsyncEngine — the same stub the pinned tests in
@@ -896,6 +994,8 @@ def child_main() -> None:
         result = _phase_primary(config, platform, device_kind, small)
     elif phase == "serving":
         result = _phase_serving(config, small)
+    elif phase == "serving_churn":
+        result = _phase_serving_churn(config, small)
     elif phase == "ablations":
         result = _phase_ablations(config, small)
     elif phase == "8b":
@@ -1052,7 +1152,7 @@ def main() -> None:
     # decodes), and a timeout kill mid-TPU-RPC has wedged the tunnel for
     # every phase after it (round 5) — order so a wedge costs nothing.
     for phase, cap in (
-        ("serving", 420.0), ("8b", 500.0),
+        ("serving", 420.0), ("serving_churn", 300.0), ("8b", 500.0),
         ("ablations", 420.0), ("longctx", 300.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)

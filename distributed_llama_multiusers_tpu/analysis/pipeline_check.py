@@ -1,17 +1,20 @@
 """pipeline-sync: the pipelined dispatch half must never touch the host.
 
 The async decode pipeline's whole point is that the dispatch half
-(``engine.decode_pipelined``, ``scheduler._pipeline_dispatch``) enqueues
-the next device step from host METADATA only — the tokens feeding it stay
-on device. One stray ``np.asarray`` / ``.item()`` / implicit bool of a
-device value in there blocks the host on the in-flight step and silently
-re-serializes the chain: the code still produces identical streams, so
-nothing but a latency graph would ever catch it. This check makes the
-regression a lint failure instead.
+(``engine.decode_pipelined``, the fused admission dispatch
+``engine.decode_prefill_fused``, ``scheduler._pipeline_dispatch``)
+enqueues the next device step from host METADATA only — the tokens
+feeding it stay on device. One stray ``np.asarray`` / ``.item()`` /
+implicit bool of a device value in there blocks the host on the in-flight
+step and silently re-serializes the chain: the code still produces
+identical streams, so nothing but a latency graph would ever catch it.
+This check makes the regression a lint failure instead.
 
 Scope: functions named in ``PIPELINE_FUNCS`` inside ``runtime/engine.py``
-and ``runtime/scheduler.py`` (the two halves the scheduler restructure
-created). Stricter than host-sync (which also covers these files): inside
+and ``runtime/scheduler.py`` — the dispatch halves the scheduler
+restructure created, including the fused prefill+decode path (stall-free
+admissions: the prompt chunk is host data going IN; nothing may come
+back). Stricter than host-sync (which also covers these files): inside
 the dispatch half even a *counted, waived-elsewhere-style* transfer is
 wrong by construction, so every sync construct needs its own explicit
 ``# dlint: ok[pipeline-sync] reason`` — and there should essentially never
@@ -43,9 +46,12 @@ from .core import (
 )
 
 SCOPE = ("runtime/engine.py", "runtime/scheduler.py")
-# the dispatch halves by name: the engine's public dispatch entry point and
-# the scheduler's dispatch-half method
-PIPELINE_FUNCS = ("decode_pipelined", "_pipeline_dispatch")
+# the dispatch halves by name: the engine's public dispatch entry points
+# (plain pipelined step + the fused prefill+decode admission step) and the
+# scheduler's dispatch-half method
+PIPELINE_FUNCS = (
+    "decode_pipelined", "decode_prefill_fused", "_pipeline_dispatch",
+)
 
 SYNC_METHODS = {"item", "tolist", "block_until_ready", "all_logits",
                 "lane_logits", "device_get"}
@@ -60,8 +66,8 @@ class PipelineSyncChecker(Checker):
     name = "pipeline-sync"
     description = (
         "host-sync constructs inside the pipelined dispatch half "
-        "(engine.decode_pipelined / scheduler._pipeline_dispatch) "
-        "re-serialize the async chain"
+        "(engine.decode_pipelined / engine.decode_prefill_fused / "
+        "scheduler._pipeline_dispatch) re-serialize the async chain"
     )
 
     def check(self, sf: SourceFile, project: Project):

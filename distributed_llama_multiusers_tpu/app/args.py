@@ -113,6 +113,17 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "with device execution; token streams stay "
                         "byte-identical to synchronous stepping. 0 or 1 "
                         "disables; default: engine default (2)")
+    p.add_argument("--fused-prefill", default="on", choices=["on", "off"],
+                   help="serving: stall-free admissions — a queued request "
+                        "claims a lane inside the live async decode chain "
+                        "and its prompt chunks ride fused prefill+decode "
+                        "dispatches (one compiled program advances every "
+                        "decoding lane one token AND consumes one bounded "
+                        "prompt chunk), so admissions never flush the "
+                        "pipeline and pipeline_flushes stays ~0 under "
+                        "churn. 'off' restores the pre-fused behavior: an "
+                        "admission exits the chain to the synchronous "
+                        "admit+prefill path (escape hatch)")
     # train mode (beyond parity — no reference analogue)
     p.add_argument("--data", default=None,
                    help="train: UTF-8 text file tokenized into training batches")

@@ -205,6 +205,9 @@ def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
         overrides["prefix_min_tokens"] = pmt
     if ms is not None:
         overrides["multi_step"] = ms
+    fp = getattr(args, "fused_prefill", None)
+    if fp is not None:  # --fused-prefill on/off (stall-free admissions)
+        overrides["fused_prefill"] = fp == "on"
     # QoS surface (--max-queue / --queue-timeout / --request-budget):
     # bounded admission with per-user fair share, plus deadlines
     max_queue = getattr(args, "max_queue", 0) or 0

@@ -37,6 +37,10 @@ OP_DECODE_PIPELINED = 7  # async pipelined step: device-fed token carry,
 OP_PIPELINE_FLUSH = 8  # root ended/aborted a pipelined chain: workers drain
 # their own rings and drop their carries (no device program to replay, but
 # a worker holding stale in-flight steps pins device buffers between chains)
+OP_DECODE_PREFILL_FUSED = 9  # stall-free admission: ONE dispatch that both
+# advances the pipelined decode lanes and consumes a bounded prompt chunk
+# for one admitting lane — bucket + chunk header ride the packet so every
+# process compiles/replays the identical per-bucket fused program
 
 
 def maybe_initialize_distributed(args=None) -> int:
@@ -92,6 +96,11 @@ class ControlPlane:
     flush, 0 = continue from the worker's own device carry) and
     ``start_pos`` carries the ring depth, so every process runs the same
     async chain with the same bounded lag.
+    DECODE_PREFILL_FUSED: the DECODE_PIPELINED slots plus payload_f = the
+    prompt-chunk tokens and payload_g = the prefill header
+    [p_lane, p_start, p_n, p_temp bits, p_topp bits, p_seed bits] — the
+    chunk length p_n picks the prefill bucket, so every process compiles
+    and replays the identical fused prefill+decode program.
     DECODE also rides its want_logits flag in the ``lane`` header field:
     the logits-materializing and no-logits steps are different compiled
     programs, and every process must dispatch the same one.
@@ -169,6 +178,29 @@ class ControlPlane:
             np.asarray(temps, np.float32).view(np.int32),
             np.asarray(topps, np.float32).view(np.int32),
             np.asarray(seeds, np.uint32).view(np.int32),
+        )
+
+    def send_decode_prefill_fused(
+        self, tokens, positions, temps, topps, seeds, depth: int,
+        p_lane: int, chunk, p_start: int, p_temp: float, p_topp: float,
+        p_seed: int,
+    ) -> None:
+        n = len(positions)
+        # DECODE_PIPELINED header layout (feed flag in `lane`, ring depth
+        # in `start_pos`); the chunk rides slot 5 and its header slot 6
+        phdr = np.zeros(6, np.int32)
+        phdr[0:3] = (p_lane, p_start, len(chunk))
+        phdr[3] = np.asarray([p_temp], np.float32).view(np.int32)[0]
+        phdr[4] = np.asarray([p_topp], np.float32).view(np.int32)[0]
+        phdr[5] = np.asarray([p_seed & 0xFFFFFFFF], np.uint32).view(np.int32)[0]
+        self._send(
+            OP_DECODE_PREFILL_FUSED, 0 if tokens is None else 1, n, depth,
+            tokens, positions,
+            np.asarray(temps, np.float32).view(np.int32),
+            np.asarray(topps, np.float32).view(np.int32),
+            np.asarray(seeds, np.uint32).view(np.int32),
+            np.asarray(chunk, np.int32),
+            phdr,
         )
 
     def send_decode_spec(
@@ -316,6 +348,9 @@ class RootControlEngine:
         host-only (they dispatch no device program, so there is nothing to
         replay) and forward through __getattr__; workers bound their own
         rings from the depth in the header."""
+        # ring-full/missing-carry must raise BEFORE the packet goes out: a
+        # broadcast with no matching root-side compute desyncs the pod
+        self._engine.check_pipelined_dispatch(tokens is not None)
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_pipelined(
             None if tokens is None else np.asarray(tokens, np.int32),
@@ -324,6 +359,49 @@ class RootControlEngine:
         )
         return self._engine.decode_pipelined(
             positions, temps, topps, seeds, tokens=tokens
+        )
+
+    def decode_prefill_fused(
+        self, positions, temps=None, topps=None, seeds=None,
+        p_lane: int = 0, chunk=None, p_start: int = 0, p_temp: float = 0.0,
+        p_topp: float | None = None, p_seed: int = 0, tokens=None,
+    ):
+        """Stall-free admission on a pod: the fused prefill+decode packet
+        goes out first (bucket implied by the chunk length, prefill header
+        in its own slot), then the root enqueues its own half — every
+        process dispatches the identical per-bucket fused program. The
+        multihost prefill path for a mid-serving admission IS this op:
+        no separate OP_PREFILL round is broadcast."""
+        if p_topp is None:  # byte-identical default on packet AND root call
+            from ..runtime.engine import DEFAULT_TOPP as p_topp
+        # validate BEFORE broadcasting (the prefill_chunk rule): every
+        # packet must pair with exactly one root-side compute or the pod
+        # deadlocks on mismatched collectives. The packet-capacity check
+        # plus the FULL engine validation set (chunk bounds, seq_len
+        # overflow, ring-full, missing carry) — any of those raising after
+        # the broadcast would leave worker rings permanently diverged
+        limit = min(self._plane.chunk, self._engine.max_chunk())
+        if chunk is None or not 1 <= len(chunk) <= limit:
+            raise ValueError(
+                f"fused prefill chunk of {0 if chunk is None else len(chunk)} "
+                f"outside [1, {limit}] (plane packet capacity "
+                f"{self._plane.chunk}, engine bucket {self._engine.max_chunk()})"
+            )
+        self._engine.check_fused_dispatch(
+            list(chunk), p_start, tokens is not None
+        )
+        temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._plane.send_decode_prefill_fused(
+            None if tokens is None else np.asarray(tokens, np.int32),
+            np.asarray(positions, np.int32), temps, topps, seeds,
+            depth=getattr(self._engine, "pipeline_depth", 2),
+            p_lane=p_lane, chunk=list(chunk), p_start=p_start,
+            p_temp=p_temp, p_topp=p_topp, p_seed=p_seed,
+        )
+        return self._engine.decode_prefill_fused(
+            positions, temps, topps, seeds,
+            p_lane=p_lane, chunk=list(chunk), p_start=p_start,
+            p_temp=p_temp, p_topp=p_topp, p_seed=p_seed, tokens=tokens,
         )
 
     def pipeline_flush(self) -> int:
@@ -435,6 +513,29 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 2, n).view(np.float32),
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
+                tokens=plane.slot(pkt, 0, n) if lane else None,
+            )
+        elif op == OP_DECODE_PREFILL_FUSED:
+            # the pipelined replay rules (feed flag in `lane`, ring depth
+            # in `start_pos`, bounded-lag consume) plus the prompt chunk +
+            # prefill header riding slots 5/6 — the worker dispatches the
+            # same per-bucket fused program the root did
+            if lane:
+                engine.pipeline_flush(count=False)  # reseed: same lagged drain
+            elif engine.pipeline_inflight() >= max(1, start_pos):
+                engine.pipeline_consume()
+            phdr = plane.slot(pkt, 6, 6)
+            engine.decode_prefill_fused(
+                plane.slot(pkt, 1, n),
+                plane.slot(pkt, 2, n).view(np.float32),
+                plane.slot(pkt, 3, n).view(np.float32),
+                plane.slot(pkt, 4, n).view(np.uint32),
+                p_lane=int(phdr[0]),
+                chunk=[int(t) for t in plane.slot(pkt, 5, int(phdr[2]))],
+                p_start=int(phdr[1]),
+                p_temp=float(phdr[3:4].view(np.float32)[0]),
+                p_topp=float(phdr[4:5].view(np.float32)[0]),
+                p_seed=int(phdr[5:6].view(np.uint32)[0]),
                 tokens=plane.slot(pkt, 0, n) if lane else None,
             )
         elif op == OP_DECODE_SPEC:

@@ -77,10 +77,20 @@ class EngineStats:
     # which the synchronous path would have serialized
     pipeline_dispatches: int = 0  # pipelined steps dispatched
     pipeline_flushes: int = 0  # chains aborted before their lanes finished
-    # (admission/speculation/host-exact flush); a natural end-of-chain
-    # drain does not count, so steady-state decode reads 0
+    # (speculation/host-exact/stop flush); a natural end-of-chain drain
+    # does not count, and with fused prefill an admission does not either,
+    # so steady-state decode — churn included — reads 0
     pipeline_depth_hist: dict = field(default_factory=dict)  # ring depth
     # right after each dispatch -> count (how deep the overlap actually ran)
+    # stall-free admissions (decode_prefill_fused):
+    fused_steps: int = 0  # fused prefill+decode dispatches (each advances
+    # every generating lane one token AND consumes one prompt chunk)
+    admission_stall_s: float = 0.0  # host time generating lanes spent
+    # stalled behind admission work (sync prefill chunks, or in-chain lane
+    # claims taken while the ring was empty); ~0 when fused dispatches
+    # carry the admission under a full ring
+    fused_bucket_hist: dict = field(default_factory=dict)  # prefill bucket
+    # -> fused dispatches that carried a chunk of that bucket
     # estimated per-step collective payload (bytes/chip), from the compiled
     # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
     sync_bytes_per_decode: int = 0
@@ -105,6 +115,7 @@ class EngineStats:
             "prefix_hits", "prefix_tokens_saved", "multi_dispatches",
             "overlap_s", "pipeline_dispatches", "pipeline_flushes",
             "pipeline_depth_hist",
+            "fused_steps", "admission_stall_s", "fused_bucket_hist",
             "sync_bytes_per_decode", "sync_collectives_per_decode",
         ),
     }
@@ -133,6 +144,9 @@ class EngineStats:
             self.multi_dispatches = 0
             self.pipeline_dispatches = self.pipeline_flushes = 0
             self.pipeline_depth_hist = {}
+            self.fused_steps = 0
+            self.admission_stall_s = 0.0
+            self.fused_bucket_hist = {}
             # sync_* stay: they describe the compiled program, not a window
         return snap
 
@@ -364,19 +378,27 @@ class InferenceEngine:
 
         self._decode_spec_fn = _decode_spec
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def _prefill(params, cache, lane, tokens, start_pos, n_tokens,
-                     temp, topp, seed):
-            # tokens: [bucket] int32, first n_tokens real; lane, start_pos,
-            # n_tokens traced scalars (one compile per bucket size only).
+        def _prefill_half(params, cache, lane, tokens, start_pos, n_tokens,
+                          temp, topp, seed):
+            """The prompt-chunk math shared by ``_prefill`` and the fused
+            ``_decode_prefill``: lane slice, forward, KV splice, boundary
+            argmax + fused sample. ONE implementation, so the fused
+            admission path's byte-identical-to-prefill_chunk contract
+            holds structurally, not by parallel maintenance.
+
+            tokens: [bucket] int32, first n_tokens real; lane, start_pos,
+            n_tokens traced scalars (one compile per bucket size only).
+            Padded tail tokens write at positions >= start_pos + n_tokens,
+            which later real writes overwrite before they become readable
+            (mask s <= pos), so no masking is needed. First-token sampling
+            is compiled into the step: multi-host pods replay the
+            identical program (a root-only jit over the global-mesh logits
+            would not be dispatchable)."""
             bucket = tokens.shape[0]
             # slice this lane's cache to batch-of-1
             k_lane = jax.lax.dynamic_slice_in_dim(cache.k, lane, 1, axis=1)
             v_lane = jax.lax.dynamic_slice_in_dim(cache.v, lane, 1, axis=1)
             positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
-            # padded tail tokens write at positions >= start_pos + n_tokens,
-            # which later real writes overwrite before they become readable
-            # (mask s <= pos), so no masking is needed
             logits, lane_cache = llama_forward(
                 cfg,
                 params,
@@ -391,17 +413,74 @@ class InferenceEngine:
             v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)
             last = jax.lax.dynamic_index_in_dim(logits[0], n_tokens - 1, axis=0, keepdims=False)
             greedy = jnp.argmax(last).astype(jnp.int32)
-            # first-token sampling compiled into the prefill step: multi-host
-            # pods replay the identical program (a root-only jit over the
-            # global-mesh logits would not be dispatchable)
             sampled = _sample_lane(
                 last, temp, topp, seed, start_pos + n_tokens - 1, greedy
+            )
+            return last, greedy, sampled, KVCache(k=k, v=v)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _prefill(params, cache, lane, tokens, start_pos, n_tokens,
+                     temp, topp, seed):
+            last, greedy, sampled, cache = _prefill_half(
+                params, cache, lane, tokens, start_pos, n_tokens,
+                temp, topp, seed,
             )
             return (
                 replicate(last),
                 replicate(jnp.stack([greedy, sampled])),
-                KVCache(k=k, v=v),
+                cache,
             )
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _decode_prefill(params, cache, feed, positions, temps, topps,
+                            seeds, p_lane, p_tokens, p_start, p_n, p_temp,
+                            p_topp, p_seed):
+            """Fused prefill+decode: ONE device dispatch that consumes one
+            bucketed prompt chunk for lane ``p_lane`` AND advances every
+            generating lane one pipelined decode step — the stall-free
+            admission unit. Compiles once per prefill bucket (p_tokens
+            shape), like ``_prefill``.
+
+            The prefill half IS ``_prefill``'s math — the shared
+            ``_prefill_half`` closure (lane slice, padded-tail
+            overwrite-before-readable, boundary-token sampling fused in);
+            the decode half is byte-identical math to
+            ``_decode_pl`` (same feed rule, same fold_in(seed, pos) draws)
+            — lanes are a batch axis, so the admitting lane's fresh KV is
+            invisible to the generating lanes' attention and their token
+            streams equal the unfused path's exactly. The admitting lane
+            rides the decode batch too, parked at position seq_len (its
+            junk write drops, its junk sample is overwritten below).
+
+            Carry: the admitting lane's slot holds the chunk's boundary
+            token (greedy at temp 0, fused-sampled otherwise — exactly the
+            first generated token when this is the FINAL chunk), so the
+            next dispatch can feed a freshly admitted lane without any
+            host round-trip; mid-prompt that slot is junk the same way an
+            idle lane's is. Output is ONE [2, n+1] pack: decode greedy/
+            sampled rows plus the prefill boundary pair in the extra
+            column."""
+            _, p_greedy, p_sampled, cache = _prefill_half(
+                params, cache, p_lane, p_tokens, p_start, p_n,
+                p_temp, p_topp, p_seed,
+            )
+            _, greedy, sampled, cache = _decode_core(
+                params, cache, feed, positions, temps, topps, seeds
+            )
+            nxt = jnp.where(temps == 0.0, greedy, sampled)
+            # host-exact admissions never take the fused path, so the
+            # boundary feed rule is the plain temp-0-greedy-else-sampled
+            # select the sync _prefill_step applies
+            p_first = jnp.where(p_temp == 0.0, p_greedy, p_sampled)
+            nxt = nxt.at[p_lane].set(p_first)
+            packed = jnp.concatenate(
+                [
+                    jnp.stack([greedy, sampled]),
+                    jnp.stack([p_greedy, p_sampled])[:, None],
+                ],
+                axis=1,
+            )
+            return replicate(nxt), replicate(packed), cache
 
         @partial(jax.jit, donate_argnums=(0,))
         def _copy_lane(cache, src, dst):
@@ -458,6 +537,7 @@ class InferenceEngine:
         self._decode_multi_fns: dict[int, object] = {}
 
         self._copy_lane_fn = _copy_lane
+        self._decode_prefill_fn = _decode_prefill
         self._decode_fn = _decode
         self._decode_nologits_fn = _decode_nologits
         self._decode_pl_fn = _decode_pl
@@ -701,18 +781,9 @@ class InferenceEngine:
             topps = np.full(n, DEFAULT_TOPP, np.float32)
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
-        if len(self._pl_inflight) >= max(1, self.pipeline_depth):
-            raise RuntimeError(
-                f"pipeline ring full (depth {self.pipeline_depth}): consume "
-                "the oldest in-flight step before dispatching another"
-            )
+        self.check_pipelined_dispatch(tokens is not None)
         if tokens is None:
             feed = self._pl_carry
-            if feed is None:
-                raise RuntimeError(
-                    "no device token carry: seed the chain with tokens= "
-                    "(first dispatch after construction or a flush)"
-                )
         else:
             feed = jnp.asarray(tokens, jnp.int32)
         nxt, packed, self.cache = self._decode_pl_fn(
@@ -733,12 +804,131 @@ class InferenceEngine:
                 self.stats.pipeline_depth_hist.get(d, 0) + 1
             )
 
+    # pod roots broadcast fused admission steps as OP_DECODE_PREFILL_FUSED
+    supports_fused_prefill = True
+
+    def check_pipelined_dispatch(self, reseed: bool) -> None:
+        """Raise every host-side error a pipelined dispatch would, WITHOUT
+        dispatching: pod roots call this before broadcasting the control
+        packet so a bad call dies on the root with ZERO packets out — a
+        packet whose root-side compute never happens leaves worker rings
+        and carries desynced and deadlocks the next collective."""
+        if len(self._pl_inflight) >= max(1, self.pipeline_depth):
+            raise RuntimeError(
+                f"pipeline ring full (depth {self.pipeline_depth}): consume "
+                "the oldest in-flight step before dispatching another"
+            )
+        if not reseed and self._pl_carry is None:
+            raise RuntimeError(
+                "no device token carry: seed the chain with tokens= "
+                "(first dispatch after construction or a flush)"
+            )
+
+    def check_fused_dispatch(self, chunk, p_start: int, reseed: bool) -> None:
+        """``check_pipelined_dispatch`` plus the prompt-chunk bounds the
+        fused prefill half enforces — the full pre-broadcast validation
+        set for OP_DECODE_PREFILL_FUSED."""
+        if not chunk:
+            raise ValueError("fused prefill needs a non-empty prompt chunk")
+        if len(chunk) > self.max_chunk():
+            raise ValueError(
+                f"chunk of {len(chunk)} exceeds bucket {self.max_chunk()}"
+            )
+        if p_start + len(chunk) > self.config.seq_len:
+            raise ValueError(
+                f"chunk of {len(chunk)} tokens at pos {p_start} exceeds "
+                f"seq_len {self.config.seq_len}"
+            )
+        self.check_pipelined_dispatch(reseed)
+
+    def decode_prefill_fused(
+        self,
+        positions: np.ndarray,
+        temps: np.ndarray | None = None,
+        topps: np.ndarray | None = None,
+        seeds: np.ndarray | None = None,
+        p_lane: int = 0,
+        chunk: list[int] | None = None,
+        p_start: int = 0,
+        p_temp: float = 0.0,
+        p_topp: float = DEFAULT_TOPP,
+        p_seed: int = 0,
+        tokens: np.ndarray | None = None,
+    ) -> None:
+        """Dispatch ONE fused prefill+decode step into the pipelined ring:
+        every generating lane advances one token (the ``decode_pipelined``
+        feed rule, carry and all) AND lane ``p_lane`` consumes one bounded
+        prompt chunk — the same dispatch, the same compiled program (one
+        per prefill bucket). Admissions therefore ride the live chain
+        instead of flushing it: the chain's dispatch cadence is untouched
+        and ``pipeline_flushes`` stays 0 under steady churn.
+
+        The admitting lane's decode-batch position must park at seq_len
+        (callers pass it that way; its junk decode write drops under the
+        mode="drop" scatter — the chunk's own KV writes are the real
+        ones). The carry slot for ``p_lane`` comes back as the chunk's
+        boundary token, so when this is the prompt's final chunk the NEXT
+        dispatch can feed the freshly admitted lane straight from device.
+        Consume via ``pipeline_consume`` like any other step; the packed
+        readback is [2, n+1], the extra column being the boundary
+        greedy/sampled pair.
+
+        Junk-KV safety is the ``prefill_chunk`` contract verbatim: padded
+        tail writes and any in-flight decode overshoot land in slots that
+        are rewritten before any query can read them."""
+        n = self.n_lanes
+        if temps is None:
+            temps = np.zeros(n, np.float32)
+        if topps is None:
+            topps = np.full(n, DEFAULT_TOPP, np.float32)
+        if seeds is None:
+            seeds = np.zeros(n, np.uint32)
+        self.check_fused_dispatch(chunk, p_start, tokens is not None)
+        if tokens is None:
+            feed = self._pl_carry
+        else:
+            feed = jnp.asarray(tokens, jnp.int32)
+        bucket = self.bucket_for(len(chunk))
+        padded = np.zeros(bucket, np.int32)
+        padded[: len(chunk)] = chunk
+        nxt, packed, self.cache = self._decode_prefill_fn(
+            self.params,
+            self.cache,
+            feed,
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topps, jnp.float32),
+            jnp.asarray(seeds, jnp.uint32),
+            jnp.int32(p_lane),
+            jnp.asarray(padded),
+            jnp.int32(p_start),
+            jnp.int32(len(chunk)),
+            jnp.float32(p_temp),
+            jnp.float32(p_topp),
+            jnp.uint32(p_seed & 0xFFFFFFFF),
+        )
+        self._pl_carry = nxt
+        self._pl_inflight.append((packed, time.perf_counter()))
+        with self.stats.lock:
+            self.stats.pipeline_dispatches += 1
+            self.stats.fused_steps += 1
+            self.stats.prefill_tokens += len(chunk)
+            self.stats.fused_bucket_hist[bucket] = (
+                self.stats.fused_bucket_hist.get(bucket, 0) + 1
+            )
+            d = len(self._pl_inflight)
+            self.stats.pipeline_depth_hist[d] = (
+                self.stats.pipeline_depth_hist.get(d, 0) + 1
+            )
+
     def pipeline_consume(self):
         """Blocking readback of the OLDEST in-flight pipelined step — the
         lagged half of the pipeline: while this step's [2, n] token rows
-        cross to the host, the younger dispatches keep the device busy.
-        Returns (greedy np[n], sampled np[n]); the token a lane fed into
-        the NEXT in-flight step is greedy[i] for temp-0 lanes and
+        (or [2, n+1] for a fused prefill+decode step — the extra column is
+        the chunk's boundary token pair) cross to the host, the younger
+        dispatches keep the device busy.
+        Returns (greedy np[n|n+1], sampled np[n|n+1]); the token a lane
+        fed into the NEXT in-flight step is greedy[i] for temp-0 lanes and
         sampled[i] otherwise (the on-device feed rule)."""
         if not self._pl_inflight:
             raise RuntimeError("pipeline ring empty: nothing to consume")
@@ -942,10 +1132,11 @@ def warmup_engine(
 ) -> None:
     """Compile every serving program up front (each prefill bucket, decode
     with AND without the logits output, the speculative verify step, every
-    multi-step horizon bucket the scheduler can pick, and the pipelined
-    step) so the first real request doesn't pay XLA compiles mid-service —
-    the analogue of the reference finishing its executor build before
-    accepting connections (src/app.cpp:233-312).
+    multi-step horizon bucket the scheduler can pick, the pipelined step,
+    and the fused prefill+decode step per bucket) so the first real
+    request doesn't pay XLA compiles mid-service — the analogue of the
+    reference finishing its executor build before accepting connections
+    (src/app.cpp:233-312).
 
     Deliberately a FREE function driving the PUBLIC engine API: on a
     multi-host pod root the proxy's decode/prefill_chunk broadcast control
@@ -985,6 +1176,16 @@ def warmup_engine(
         ):
             engine.decode_pipelined(z, tokens=z)
             engine.pipeline_flush()
+            if getattr(engine, "supports_fused_prefill", False):
+                # the fused prefill+decode family compiles per bucket —
+                # without this, the FIRST admission into a live chain
+                # pays a fresh XLA compile exactly when lanes are hot
+                park = np.full(n, engine.config.seq_len, np.int32)
+                for bucket in engine.prefill_buckets:
+                    engine.decode_prefill_fused(
+                        park, p_lane=0, chunk=[0] * bucket, tokens=z,
+                    )
+                    engine.pipeline_flush()
     # pod roots: drop the replayed warmup traffic from worker counters too
     reset_workers = getattr(engine, "reset_worker_stats", None)
     if reset_workers is not None:

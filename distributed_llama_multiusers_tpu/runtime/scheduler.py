@@ -190,6 +190,7 @@ class ContinuousBatchingScheduler:
         multi_step: int = 8,
         deadlines: DeadlinePolicy | None = None,
         pipelined: bool = True,
+        fused_prefill: bool = True,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
@@ -229,6 +230,21 @@ class ContinuousBatchingScheduler:
         a queued admission, or a prefill force a flush back to the
         synchronous path.
 
+        ``fused_prefill`` (default on; engines with
+        ``supports_fused_prefill`` and pipelining active only): admissions
+        no longer flush the pipelined chain. A queued request claims a
+        free lane inside the live chain and its prompt chunks ride FUSED
+        prefill+decode dispatches (``engine.decode_prefill_fused``): one
+        device program advances every generating lane one token AND
+        consumes one bounded chunk, so decode lanes never stall behind an
+        admission and ``pipeline_flushes`` stays 0 under steady churn.
+        Streams remain byte-identical to the synchronous path (the fused
+        program's decode half is the pipelined step's math verbatim; the
+        prefill half is ``prefill_chunk``'s). Host-exact admissions are
+        the one kind that still flushes (they read full logits every
+        step). Off: the pre-fused behavior — an admission exits the chain
+        to the synchronous admit+prefill path.
+
         ``deadlines`` (serving/deadlines.py): server-wide queue-wait
         timeout and wall-clock generation budget; expired requests finish
         with ``finish_reason="timeout"`` (queued ones without ever taking a
@@ -250,6 +266,7 @@ class ContinuousBatchingScheduler:
         self.prefix_min_tokens = prefix_min_tokens
         self.multi_step = multi_step
         self.pipelined = pipelined
+        self.fused_prefill = fused_prefill
         self._lanes = [_Lane() for _ in range(engine.n_lanes)]
         # tokens whose KV each lane's cache currently holds at slots
         # [0, len): survives request finish (the KV physically remains),
@@ -397,31 +414,46 @@ class ContinuousBatchingScheduler:
                 self.queue_timeouts += 1
                 self._resolve_unadmitted(req, "timeout")
 
+    def _claim_next(self, free: list[int], wait_s: float = 0.0):
+        """Pop ONE queued request and claim a lane for it — the shared
+        admission body behind the synchronous ``_admit`` loop and the
+        in-chain ``_claim_admissions``: cancel/expiry resolution at pop
+        time, the ``admitted_at`` stamp, tokenize+seed via
+        ``_start_request`` with its failure handling. Returns the claimed
+        lane index, ``None`` when the pop found nothing (stop polling), or
+        ``-1`` when the popped request was resolved without taking a lane
+        (cancelled/expired/failed — keep popping)."""
+        req = self.queue.pop(timeout=wait_s)
+        if req is None:
+            return None
+        now = time.monotonic()
+        if req._cancelled.is_set():
+            self._resolve_unadmitted(req, "cancelled")
+            return -1
+        if queue_expired(req, self.deadlines, now):
+            self.queue_timeouts += 1
+            self._resolve_unadmitted(req, "timeout")
+            return -1
+        req.admitted_at = now
+        lane_idx = free.pop(0)
+        try:
+            self._start_request(lane_idx, req)
+        except Exception as e:  # tokenization errors fail the request
+            req.state = RequestState.FAILED
+            req.error = str(e)
+            self._lanes[lane_idx] = _Lane()
+            if not req.future.done():
+                req.future.set_exception(e)
+            return -1
+        return lane_idx
+
     def _admit(self, wait_s: float = 0.0) -> None:
         free = self._free_lane_indices()
         while free:
-            req = self.queue.pop(timeout=wait_s)
+            claimed = self._claim_next(free, wait_s)
             wait_s = 0.0  # only the first pop may park; the rest are polls
-            if req is None:
+            if claimed is None:
                 return
-            now = time.monotonic()
-            if req._cancelled.is_set():
-                self._resolve_unadmitted(req, "cancelled")
-                continue
-            if queue_expired(req, self.deadlines, now):
-                self.queue_timeouts += 1
-                self._resolve_unadmitted(req, "timeout")
-                continue
-            req.admitted_at = now
-            lane_idx = free.pop(0)
-            try:
-                self._start_request(lane_idx, req)
-            except Exception as e:  # tokenization/prefill errors fail the request
-                req.state = RequestState.FAILED
-                req.error = str(e)
-                self._lanes[lane_idx] = _Lane()
-                if not req.future.done():
-                    req.future.set_exception(e)
 
     def _start_request(self, lane_idx: int, req: Request) -> None:
         """Tokenize and claim a lane. Prompt processing itself happens one
@@ -573,11 +605,13 @@ class ContinuousBatchingScheduler:
         """How many decode steps to chain in one device dispatch (0/1 =
         plain single step). Multi-step is correct only in steady-state
         decode: no prompt chunk was processed this iteration (no lane is
-        admitting), nothing is queued (an admission would wait out the
-        horizon), and no active lane needs host-exact sampling (it reads
-        full logits every step). The horizon is capped by the
-        longest-remaining lane and bucketed to powers of two so at most
-        log2(multi_step) programs ever compile."""
+        admitting), nothing is queued (unlike the fused pipelined path,
+        ``decode_multi`` cannot carry a prompt chunk, so an admission
+        would wait out the whole horizon — the queue check stays even
+        though the pipelined gate dropped it), and no active lane needs
+        host-exact sampling (it reads full logits every step). The horizon
+        is capped by the longest-remaining lane and bucketed to powers of
+        two so at most log2(multi_step) programs ever compile."""
         if self.multi_step <= 1 or prefilled:
             return 0
         if not getattr(self.engine, "supports_multi_step", False):
@@ -598,36 +632,139 @@ class ContinuousBatchingScheduler:
         p = pow2_floor(min(self.multi_step, rem))
         return p if p > 1 else 0
 
-    def _pipeline_ok(self, active, prefilled: bool) -> bool:
-        """Gate for the pipelined path — the multi-step gate's steady-state
-        conditions (no prompt chunk this iteration, nothing queued, no
-        host-exact-sampling lane) plus engine support and a ring depth that
-        actually buys a lag. Drafts are the caller's business: when the
-        speculative probe produced any, the spec path runs instead."""
+    def _fused_ok(self) -> bool:
+        """Fused prefill+decode admissions available: the flag is on, the
+        engine compiles the fused step family, and pipelining is live."""
+        return (
+            self.fused_prefill
+            and self.pipelined
+            and getattr(self.engine, "supports_fused_prefill", False)
+            and getattr(self.engine, "supports_pipelined", False)
+            and getattr(self.engine, "pipeline_depth", 0) >= 2
+        )
+
+    def _drafts_pending(self, live: dict) -> bool:
+        """Host-side probe: does any GENERATING greedy lane's history draft?
+        A hit is a pipeline flush condition — the spec path emits >1 token
+        per forward and wins. Lanes still mid-admission (their first token
+        not yet consumed) are skipped: their ``next_token`` is not set."""
+        spec_k = (
+            getattr(self.engine, "SPEC_DRAFT", 0)
+            if self.speculative
+            and getattr(self.engine, "supports_speculative", False)
+            else 0
+        )
+        if spec_k <= 0:
+            return False
+        seq_len = self.engine.config.seq_len
+        return any(
+            lane.request.state == RequestState.GENERATING
+            and lane.request.temperature == 0.0
+            and seq_len - lane.pos - 1 > 0
+            and lane.drafter.draft(lane.next_token, spec_k)
+            for lane in live.values()
+        )
+
+    def _pipeline_ok(self, active, prefilled: bool = False) -> bool:
+        """Gate for the pipelined path. The unconditional steady-state
+        terms: engine support, a ring depth that actually buys a lag, and
+        no host-exact-sampling lane (it reads full logits every step).
+        With fused prefill, a queued admission or a pending prompt chunk
+        no longer disqualifies — the chain itself claims lanes and streams
+        their chunks through fused dispatches, so admission never exits
+        steady state. Without it, the pre-fused conditions apply (nothing
+        queued, no admitting lane, no chunk processed this iteration).
+        Drafts are the caller's business: when the speculative probe
+        produced any, the spec path runs instead."""
         if not self.pipelined or prefilled:
             return False
         if not getattr(self.engine, "supports_pipelined", False):
             return False
         if getattr(self.engine, "pipeline_depth", 0) < 2:
             return False
+        # ALL occupied lanes, not just the generating ones: a host-exact
+        # request still mid-admission (claimed by the sync _admit) must
+        # keep the whole batch on the synchronous path too — its boundary
+        # token needs the full-logits host sampler, which neither the
+        # fused prefill nor the pipelined decode ever reads back
+        if any(
+            l.request is not None
+            and l.host_exact
+            and l.request.temperature > 0
+            for l in self._lanes
+        ):
+            return False
+        if self._fused_ok():
+            return True
         if not self.queue.empty():
             return False
         return not any(
-            l.host_exact and l.request.temperature > 0 for _, l in active
+            l.request is not None and l.pending for l in self._lanes
         )
 
-    def _pipeline_dispatch(self, live: dict, pl_pos: dict, feed) -> None:
+    def _claim_admissions(self, admitting: dict) -> bool:
+        """Claim queued requests into free lanes WITHOUT leaving the chain
+        (the fused-prefill admission path): pop, stamp, tokenize, seed the
+        lane — host work only (plus the async prefix-cache lane copy) —
+        and hand the lane to the dispatch half, which streams its chunks
+        through fused dispatches. Returns False when a claimed lane needs
+        the synchronous path (host-exact sampling reads full logits every
+        step), the one admission kind that still flushes; the sync loop
+        picks its pending chunks up after the drain."""
+        free = self._free_lane_indices()
+        if not free or self.queue.empty():
+            return True
+        # claim time is a real decode-lane stall only when the ring is
+        # empty (nothing dispatched for the device to chew on meanwhile)
+        stalled = self.engine.pipeline_inflight() == 0
+        t0 = time.perf_counter()
+        ok = True
+        while free:
+            claimed = self._claim_next(free)
+            if claimed is None:
+                break
+            if claimed < 0:
+                continue
+            lane = self._lanes[claimed]
+            if lane.host_exact and lane.request.temperature > 0:
+                ok = False  # needs the sync path: flush after this claim
+                break
+            admitting[claimed] = lane
+        if stalled:
+            with self.engine.stats.lock:
+                self.engine.stats.admission_stall_s += (
+                    time.perf_counter() - t0
+                )
+        return ok
+
+    def _pipeline_dispatch(self, live: dict, admitting: dict, pl_pos: dict,
+                           feed):
         """Dispatch half of the pipelined loop: queue the next decode step
         from host-side lane METADATA only — positions (the scheduler knows
         each consumed step advances a live lane by exactly 1) and sampling
-        params. The tokens stay on device (``feed=None`` selects the
+        params — and, when an admitting lane has prompt chunks pending,
+        piggyback ONE bounded chunk for ONE lane (round-robin, the sync
+        ``_prefill_step`` rule) on the SAME dispatch via
+        ``engine.decode_prefill_fused``: the admission streams through the
+        live chain instead of flushing it, and an admitting iteration
+        costs one device dispatch, not a prefill dispatch plus a decode
+        dispatch. The tokens stay on device (``feed=None`` selects the
         engine's carry); nothing in here may read a device value back, or
-        the whole overlap dies — machine-checked by dlint's pipeline-sync."""
+        the whole overlap dies — machine-checked by dlint's pipeline-sync.
+
+        Returns ``(lane_idx, lane, final)`` for a fused dispatch (None for
+        a plain one). Chunk bookkeeping — ``lane.pos``, ``lane.pending``,
+        ``_lane_kv`` — commits here at DISPATCH time: the chunk's KV
+        writes execute in dispatch order whether or not the step's outputs
+        are ever consumed, so the resident-KV map stays truthful even for
+        a request cancelled mid-prompt."""
         engine = self.engine
         n_lanes = engine.n_lanes
         seq_len = engine.config.seq_len
         # idle/finished lanes park at seq_len: the mode="drop" KV scatter
-        # discards their junk writes (same rule as the sync loop)
+        # discards their junk writes (same rule as the sync loop). An
+        # admitting lane parks there too — its REAL writes this step are
+        # the fused chunk's, not the decode half's.
         positions = np.full(n_lanes, seq_len, np.int32)
         temps = np.zeros(n_lanes, np.float32)
         topps = np.full(n_lanes, DEFAULT_TOPP, np.float32)
@@ -640,22 +777,55 @@ class ContinuousBatchingScheduler:
             temps[i] = lane.request.temperature
             topps[i] = lane.request.topp
             seeds[i] = lane.seed
-        engine.decode_pipelined(positions, temps, topps, seeds, tokens=feed)
+        target = None
+        if admitting:
+            # round-robin over admitting lanes so several prompts make
+            # progress together, one chunk per dispatch
+            target = min(
+                admitting, key=lambda i: (i - self._prefill_rr) % n_lanes
+            )
+            self._prefill_rr = (target + 1) % n_lanes
+        if target is None:
+            engine.decode_pipelined(positions, temps, topps, seeds,
+                                    tokens=feed)
+            return None
+        lane = admitting[target]
+        req = lane.request
+        chunk = lane.pending[: engine.max_chunk()]
+        engine.decode_prefill_fused(
+            positions, temps, topps, seeds,
+            p_lane=target, chunk=chunk, p_start=lane.pos,
+            p_temp=0.0 if lane.host_exact else req.temperature,
+            p_topp=req.topp, p_seed=lane.seed,
+            tokens=feed,
+        )
+        lane.pos += len(chunk)
+        lane.pending = lane.pending[len(chunk):]
+        self._lane_kv[target].extend(chunk)  # committed: prefix-cacheable
+        return (target, lane, not lane.pending)
 
-    def _pipeline_consume(self, live: dict, step_lanes: tuple) -> None:
+    def _pipeline_consume(self, live: dict, entry: tuple) -> None:
         """Consume half, one step behind: block on the oldest in-flight
-        step's [2, n] token readback and run the host work the synchronous
+        step's packed token readback and run the host work the synchronous
         loop does inline — stream decode, EOS/stop, cancel/budget checks —
-        while the younger dispatches keep the device busy. ``step_lanes``
-        is the live-lane set AT DISPATCH TIME: a lane that finished at an
-        earlier consumed step contributes a junk column, skipped here (its
-        in-flight KV writes die under the overwrite-before-readable rule)."""
+        while the younger dispatches keep the device busy. ``entry`` is
+        ``(step_lanes, fused)`` recorded AT DISPATCH TIME: ``step_lanes``
+        pairs each live lane index with its lane OBJECT — the identity
+        check skips both lanes that finished at an earlier consumed step
+        AND lanes already reclaimed by a NEW request while this step was
+        still in flight (either way the column is junk, and its in-flight
+        KV writes die under the overwrite-before-readable rule).
+        ``fused`` is the dispatch half's ``(lane_idx, lane, final)`` for a
+        fused prefill+decode step, whose extra readback column carries the
+        chunk's boundary token pair: on the FINAL chunk that token is the
+        request's first generated token, committed here exactly one step
+        behind — the same point the synchronous path would have read it."""
         greedy_np, sampled_np = self.engine.pipeline_consume()
         now = time.monotonic()
-        for i in step_lanes:
-            lane = live.get(i)
-            if lane is None:
-                continue  # finished at an earlier consumed step: junk column
+        step_lanes, fused = entry
+        for i, lane in step_lanes:
+            if live.get(i) is not lane:
+                continue  # finished earlier (or lane reclaimed): junk column
             req = lane.request
             if req._cancelled.is_set():
                 self._finish(i, req, reason="cancelled")
@@ -675,6 +845,21 @@ class ContinuousBatchingScheduler:
                 lane.next_token = int(greedy_np[i])
             else:
                 lane.next_token = int(sampled_np[i])
+        if fused is not None:
+            i, lane, final = fused
+            if final and live.get(i) is lane:
+                # prompt complete: adopt the boundary token as the first
+                # generated token (greedy at temp 0, fused-sampled else —
+                # host-exact admissions never take the fused path) and go
+                # GENERATING. The lane already joined the dispatch half's
+                # live set when its final chunk went out; the carry fed it
+                # on device, and the NEXT consumed step emits this token.
+                req = lane.request
+                if req.temperature == 0.0:
+                    lane.next_token = int(greedy_np[-1])
+                else:
+                    lane.next_token = int(sampled_np[-1])
+                req.state = RequestState.GENERATING
 
     def _run_pipelined(self, active) -> None:
         """Steady-state pipelined decode: keep the ring at ``pipeline_depth``
@@ -682,55 +867,113 @@ class ContinuousBatchingScheduler:
         detokenize/stream/stop work overlaps step k+1's device execution
         instead of serializing ahead of it.
 
+        With fused prefill (the default), admission is part of steady
+        state, not an exit: a queued request claims a free lane in-chain
+        (``_claim_admissions``), its prompt chunks ride fused dispatches
+        (``_pipeline_dispatch``), and when the final chunk goes out the
+        lane joins the decode half fed by the on-device carry — the chain
+        never breaks and ``pipeline_flushes`` stays 0 under churn.
+
         Exits by DRAINING the remaining in-flight steps through the normal
         consume path (their tokens are valid — no generated token is ever
         discarded for a live lane) when a flush condition appears: stop(),
-        a queued admission (the sync loop admits and prefills), a greedy
-        lane whose history now drafts (the spec path emits >1 token per
-        forward and wins), or every lane finishing. An exit with lanes
-        still live counts as a pipeline flush in the engine stats."""
+        a greedy lane whose history now drafts (the spec path emits >1
+        token per forward and wins), a host-exact admission (it reads full
+        logits every step, so the sync path must run it), a queued
+        admission with fused prefill OFF, or every lane finishing. An exit
+        with lanes still live counts as a pipeline flush in the engine
+        stats."""
         engine = self.engine
         depth = max(2, int(getattr(engine, "pipeline_depth", 2)))
+        fused = self._fused_ok()
         live: dict[int, _Lane] = dict(active)
+        # lanes still streaming prompt chunks (sync-admitted leftovers on
+        # entry; in-chain claims join via _claim_admissions)
+        admitting: dict[int, _Lane] = {}
+        if fused:
+            admitting = {
+                i: l for i, l in enumerate(self._lanes)
+                if l.request is not None and l.pending and i not in live
+            }
         # per-lane position of the NEXT dispatch = committed pos + in-flight
         # lag (resynced from the lanes on every entry)
         pl_pos = {i: lane.pos for i, lane in live.items()}
         feed = np.zeros(engine.n_lanes, np.int32)
         for i, lane in live.items():
             feed[i] = lane.next_token
-        meta: deque = deque()  # live-lane ids at each dispatch, oldest first
+        meta: deque = deque()  # (live lanes, fused info) per dispatch
         host_feed = True  # first dispatch reseeds the chain from host tokens
         dispatched_any = False
-        spec_k = (
-            getattr(engine, "SPEC_DRAFT", 0)
-            if self.speculative and getattr(engine, "supports_speculative", False)
-            else 0
-        )
-        seq_len = engine.config.seq_len
+        # both entry gates (_run's early fused entry and the post-spec
+        # branch) just probed the drafters; skip the duplicate probe on
+        # the first iteration of the hot loop
+        probe_drafts = False
         while True:
-            flush = self._stop.is_set() or not live or not self.queue.empty()
-            if not flush and spec_k > 0:
+            now = time.monotonic()
+            # queued cancels/expiries must not wait out a long chain
+            # (throttled internally to ~20 Hz)
+            self._sweep_queue(now)
+            # an admitting request cancelled/expired mid-prompt: stop
+            # streaming its chunks; the in-flight ones are junk-KV-safe
+            for i in [
+                j for j, l in admitting.items()
+                if l.request._cancelled.is_set()
+                or budget_expired(l.request, self.deadlines, now)
+            ]:
+                lane = admitting.pop(i)
+                if lane.request._cancelled.is_set():
+                    self._finish(i, lane.request, reason="cancelled")
+                else:
+                    self.budget_timeouts += 1
+                    self._finish(i, lane.request, reason="timeout")
+            flush = self._stop.is_set() or (not live and not admitting)
+            if not flush and fused:
+                # a claimed lane whose chunks cannot ride the chain (a
+                # host-exact admission): only the synchronous path can
+                # serve it — keep flushing until it does. Checked every
+                # iteration, not just at claim time, so the lane is never
+                # starved behind a long-lived chain.
                 flush = any(
-                    lane.request.temperature == 0.0
-                    and seq_len - lane.pos - 1 > 0
-                    and lane.drafter.draft(lane.next_token, spec_k)
-                    for lane in live.values()
+                    l.request is not None
+                    and l.pending
+                    and i not in admitting
+                    and i not in live
+                    for i, l in enumerate(self._lanes)
                 )
+            if not flush and not self.queue.empty():
+                if fused:
+                    # admissions ride the chain; only a host-exact claim
+                    # still needs the synchronous path
+                    flush = not self._claim_admissions(admitting)
+                else:
+                    flush = True
+            if not flush and probe_drafts:
+                flush = self._drafts_pending(live)
+            probe_drafts = True  # entry gates probed already; re-check
+            # from the second iteration on (new tokens land per consume)
             while not flush and engine.pipeline_inflight() < depth:
-                self._pipeline_dispatch(
-                    live, pl_pos, feed if host_feed else None
+                fused_info = self._pipeline_dispatch(
+                    live, admitting, pl_pos, feed if host_feed else None
                 )
                 host_feed = False
                 dispatched_any = True
-                meta.append(tuple(live))
+                meta.append((tuple(live.items()), fused_info))
                 for i in live:
                     pl_pos[i] += 1
+                if fused_info is not None and fused_info[2]:
+                    # final chunk dispatched: the lane joins the decode
+                    # half from the NEXT dispatch — the device carry holds
+                    # its first token, no host round-trip involved
+                    i, lane, _ = fused_info
+                    admitting.pop(i)
+                    live[i] = lane
+                    pl_pos[i] = lane.pos
             if engine.pipeline_inflight() == 0:
                 break
             self._pipeline_consume(live, meta.popleft())
-        if live and dispatched_any:
-            # cut short with lanes still generating: an actual flush (the
-            # natural all-lanes-finished drain is not)
+        if (live or admitting) and dispatched_any:
+            # cut short with lanes still generating or admitting: an actual
+            # flush (the natural all-lanes-finished drain is not)
             with engine.stats.lock:
                 engine.stats.pipeline_flushes += 1
         engine.pipeline_flush()  # ring already drained; drops the carry
@@ -778,9 +1021,46 @@ class ContinuousBatchingScheduler:
                     self.budget_timeouts += 1
                     self._finish(i, lane.request, reason="timeout")
 
+            # stall-free admissions: with fused prefill, enter the
+            # pipelined path BEFORE the synchronous prefill step — pending
+            # prompt chunks and queued admissions ride the chain itself
+            # (fused prefill+decode dispatches), so an admission no longer
+            # exits steady state
+            if self._fused_ok():
+                active = [
+                    (i, self._lanes[i])
+                    for i in range(n_lanes)
+                    if self._lanes[i].request is not None
+                    and self._lanes[i].request.state
+                    == RequestState.GENERATING
+                ]
+                if (
+                    active
+                    and self._pipeline_ok(active)
+                    and not self._drafts_pending(dict(active))
+                ):
+                    self._run_pipelined(active)
+                    continue
+
             # at most ONE prompt bucket per iteration: decoding lanes below
-            # stall no longer than one bucket while admissions stream in
+            # stall no longer than one bucket while admissions stream in.
+            # Any generating lane held up by this chunk is a real admission
+            # stall (with fused prefill this path only runs when the chain
+            # declined: drafts pending, a host-exact lane, or pipelining
+            # off — the fused chain otherwise hides admission work behind
+            # device execution)
+            had_generating = any(
+                l.request is not None
+                and l.request.state == RequestState.GENERATING
+                for l in self._lanes
+            )
+            t_pf = time.perf_counter()
             prefilled = self._prefill_step()
+            if prefilled and had_generating:
+                with self.engine.stats.lock:
+                    self.engine.stats.admission_stall_s += (
+                        time.perf_counter() - t_pf
+                    )
 
             active = [
                 (i, self._lanes[i])

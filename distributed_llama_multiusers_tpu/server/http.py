@@ -166,6 +166,16 @@ class ApiServer:
                 str(k): v
                 for k, v in sorted(stats["pipeline_depth_hist"].items())
             },
+            # stall-free admissions: fused prefill+decode dispatches taken
+            # (admissions riding the live chain), host time decode lanes
+            # spent stalled behind admission work, and which prefill
+            # buckets the fused dispatches carried
+            "fused_steps": stats["fused_steps"],
+            "admission_stall_s": round(stats["admission_stall_s"], 3),
+            "fused_bucket_hist": {
+                str(k): v
+                for k, v in sorted(stats["fused_bucket_hist"].items())
+            },
             "prefix_hits": stats["prefix_hits"],
             "prefix_tokens_saved": stats["prefix_tokens_saved"],
             "lanes_total": total,

@@ -90,14 +90,23 @@ class MockAsyncEngine:
     so the ``events`` log proves the lag structure (consume of step k runs
     while step k+1 is already dispatched) without accelerator timing noise.
     One implementation, imported by both tests/test_pipelined_decode.py and
-    bench.py, so the pinned test and the bench evidence cannot drift."""
+    bench.py, so the pinned test and the bench evidence cannot drift.
+
+    Tokens are a pure function of (lane, position) — NOT of global step
+    order — so the synchronous scheduler and the pipelined/fused one emit
+    byte-identical streams for the same requests regardless of how
+    admissions interleave: the property the fused-prefill churn tests pin.
+    Supports the fused prefill+decode dispatch (``decode_prefill_fused``)
+    with the real engine's packed-readback contract (an extra boundary
+    column on fused steps)."""
 
     supports_multi_step = False
     supports_speculative = False
     supports_pipelined = True
+    supports_fused_prefill = True
 
     def __init__(self, n_lanes=4, vocab=64, seq_len=4096, step_s=0.002,
-                 pipeline_depth=2):
+                 pipeline_depth=2, max_chunk=16):
         import types
 
         from ..runtime.engine import EngineStats
@@ -107,27 +116,36 @@ class MockAsyncEngine:
         self.stats = EngineStats()
         self.pipeline_depth = pipeline_depth
         self.step_s = step_s
+        self._max_chunk = max_chunk
         self._free_at = 0.0  # simulated device busy-until timestamp
-        self._ring = []  # (ready_at, dispatched_at, step_idx)
+        # (ready_at, dispatched_at, step_idx, positions copy, boundary|None)
+        self._ring = []
         self._carry_live = False
         self._steps = 0
         self.events = []  # ("dispatch"|"consume", step_idx)
 
     def max_chunk(self):
-        return 16
+        return self._max_chunk
 
     def reset_lane(self, lane):
         pass
 
-    def prefill_chunk(self, lane, chunk, start_pos, temp=0.0, topp=0.9, seed=0):
-        return None, 1, 1
+    def _tok(self, lane, pos):
+        # deterministic per (lane, position): stream identity across
+        # scheduler paths is checkable by simple equality
+        return 2 + (int(lane) * 31 + int(pos) * 7) % (self.config.vocab_size - 2)
 
-    def _toks(self, step):
+    def prefill_chunk(self, lane, chunk, start_pos, temp=0.0, topp=0.9, seed=0):
+        t = self._tok(lane, start_pos + len(chunk) - 1)
+        with self.stats.lock:
+            self.stats.prefill_tokens += len(chunk)
+        return None, t, t
+
+    def _toks_at(self, positions):
         import numpy as np
 
         return np.asarray(
-            [2 + (step * 7 + i) % (self.config.vocab_size - 2)
-             for i in range(self.n_lanes)],
+            [self._tok(i, positions[i]) for i in range(self.n_lanes)],
             np.int32,
         )
 
@@ -137,11 +155,10 @@ class MockAsyncEngine:
         now = time.monotonic()
         self._free_at = max(now, self._free_at) + self.step_s
         time.sleep(max(0.0, self._free_at - now))
-        s = self._steps
         self._steps += 1
         with self.stats.lock:
             self.stats.decode_steps += 1
-        t = self._toks(s)
+        t = self._toks_at(positions)
         return None, t, t
 
     def pipeline_inflight(self):
@@ -157,7 +174,7 @@ class MockAsyncEngine:
         self._free_at = max(now, self._free_at) + self.step_s
         s = self._steps
         self._steps += 1
-        self._ring.append((self._free_at, now, s))
+        self._ring.append((self._free_at, now, s, list(positions), None))
         self._carry_live = True
         self.events.append(("dispatch", s))
         with self.stats.lock:
@@ -167,8 +184,43 @@ class MockAsyncEngine:
                 self.stats.pipeline_depth_hist.get(d, 0) + 1
             )
 
+    def decode_prefill_fused(self, positions, temps=None, topps=None,
+                             seeds=None, p_lane=0, chunk=None, p_start=0,
+                             p_temp=0.0, p_topp=0.9, p_seed=0, tokens=None):
+        """Fused prefill+decode dispatch: one simulated device step that
+        both advances the decode lanes and consumes one prompt chunk; the
+        packed readback carries the chunk's boundary token in an extra
+        column, like the real engine's [2, n+1] pack."""
+        if not chunk:
+            raise ValueError("fused prefill needs a non-empty prompt chunk")
+        if len(chunk) > self._max_chunk:
+            raise ValueError(
+                f"chunk of {len(chunk)} exceeds bucket {self._max_chunk}"
+            )
+        now = time.monotonic()
+        self._free_at = max(now, self._free_at) + self.step_s
+        s = self._steps
+        self._steps += 1
+        boundary = self._tok(p_lane, p_start + len(chunk) - 1)
+        self._ring.append((self._free_at, now, s, list(positions), boundary))
+        self._carry_live = True
+        self.events.append(("dispatch", s))
+        with self.stats.lock:
+            self.stats.pipeline_dispatches += 1
+            self.stats.fused_steps += 1
+            self.stats.prefill_tokens += len(chunk)
+            self.stats.fused_bucket_hist[self._max_chunk] = (
+                self.stats.fused_bucket_hist.get(self._max_chunk, 0) + 1
+            )
+            d = len(self._ring)
+            self.stats.pipeline_depth_hist[d] = (
+                self.stats.pipeline_depth_hist.get(d, 0) + 1
+            )
+
     def pipeline_consume(self):
-        ready_at, dispatched_at, s = self._ring.pop(0)
+        import numpy as np
+
+        ready_at, dispatched_at, s, positions, boundary = self._ring.pop(0)
         t0 = time.monotonic()
         time.sleep(max(0.0, ready_at - t0))
         self.events.append(("consume", s))
@@ -176,7 +228,9 @@ class MockAsyncEngine:
             self.stats.decode_steps += 1
             self.stats.decode_s += max(0.0, ready_at - t0)
             self.stats.overlap_s += max(0.0, t0 - dispatched_at)
-        t = self._toks(s)
+        t = self._toks_at(positions)
+        if boundary is not None:
+            t = np.concatenate([t, np.asarray([boundary], np.int32)])
         return t, t
 
     def pipeline_flush(self, count=True):

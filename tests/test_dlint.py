@@ -324,6 +324,37 @@ def test_pipeline_sync_implicit_bool_and_cast(tmp_path):
     assert "implicit bool" in msgs and "cast" in msgs
 
 
+def test_pipeline_sync_covers_fused_dispatch(tmp_path):
+    """The fused prefill+decode admission step is a dispatch half too: a
+    host-sync construct inside ``engine.decode_prefill_fused`` (or the
+    fused branch of ``_pipeline_dispatch``) re-serializes the chain at the
+    exact moment it is supposed to hide admission work — a finding."""
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        import numpy as np
+
+        class E:
+            def decode_prefill_fused(self, positions, chunk=None, tokens=None):
+                nxt, packed, self.cache = self._decode_prefill_fn(positions)
+                return np.asarray(packed)
+    """})
+    assert "pipeline-sync" in checks_of(findings)
+    # the clean shape: host chunk data goes IN, nothing comes back
+    clean = run_on(tmp_path / "clean", {"runtime/engine.py": """
+        import numpy as np
+
+        class E:
+            def decode_prefill_fused(self, positions, chunk=None, tokens=None):
+                padded = np.zeros(16, np.int32)
+                padded[: len(chunk)] = chunk
+                nxt, packed, self.cache = self._decode_prefill_fn(
+                    positions, padded
+                )
+                self._pl_carry = nxt
+                self._pl_inflight.append(packed)
+    """})
+    assert "pipeline-sync" not in checks_of(clean)
+
+
 def test_pipeline_sync_waiver_suppresses(tmp_path):
     """A waiver naming BOTH overlapping checks silences the line (host-sync
     also scopes these files)."""

@@ -428,14 +428,17 @@ def test_mocked_scheduler_overlaps_consume_with_dispatch():
 
 
 def test_mocked_scheduler_admission_forces_flush():
-    """A queued admission mid-chain exits the pipelined mode (counted as a
-    flush) and the sync loop admits; the chain then re-forms."""
+    """With fused prefill OFF (the escape hatch), a queued admission
+    mid-chain exits the pipelined mode (counted as a flush) and the sync
+    loop admits; the chain then re-forms. The fused default keeps the
+    chain intact instead — pinned in tests/test_fused_prefill.py."""
     engine = MockAsyncEngine(n_lanes=2, step_s=0.005)
     first = Request(prompt="a", max_tokens=200, temperature=0.0)
     second = Request(prompt="b", max_tokens=8, temperature=0.0)
     sched = ContinuousBatchingScheduler(
         engine, StubStreamTokenizer(engine.config.vocab_size),
         speculative=False, prefix_min_tokens=0, multi_step=0,
+        fused_prefill=False,
     )
     sched.start()
     try:

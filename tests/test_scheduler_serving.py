@@ -39,24 +39,32 @@ def stack(tiny_model):
 
 
 def test_prefill_interleaves_with_decode(stack):
-    """While a long prompt admits, an active lane keeps decoding: between
-    any two consecutive prefill chunks there is at least one decode step
-    (the reference freezes all decoding for the whole admission prefill)."""
+    """While a long prompt admits, an active lane keeps decoding. With
+    fused prefill (the default) each admission chunk rides a dispatch
+    that ALSO advances every decoding lane (``decode_prefill_fused``), so
+    decoding never pauses at all; any chunk that still takes the
+    synchronous path must have a decode step between it and the next one
+    (the reference freezes all decoding for the whole admission
+    prefill)."""
     config, engine, tok = stack
     calls = []
-    real_chunk = engine.prefill_chunk
-    real_decode = engine.decode
+    real = {}
 
-    def rec_chunk(*a, **k):
-        calls.append("prefill")
-        return real_chunk(*a, **k)
+    def rec(name, label):
+        fn = getattr(engine, name)
+        real[name] = fn
 
-    def rec_decode(*a, **k):
-        calls.append("decode")
-        return real_decode(*a, **k)
+        def wrapper(*a, **k):
+            calls.append(label)
+            return fn(*a, **k)
 
-    engine.prefill_chunk = rec_chunk
-    engine.decode = rec_decode
+        setattr(engine, name, wrapper)
+
+    rec("prefill_chunk", "prefill")
+    rec("decode", "decode")
+    rec("decode_spec", "decode")
+    rec("decode_pipelined", "decode")
+    rec("decode_prefill_fused", "fused")  # one chunk AND one decode step
     sched = ContinuousBatchingScheduler(engine, tok)
     sched.start()
     try:
@@ -73,19 +81,19 @@ def test_prefill_interleaves_with_decode(stack):
         b.future.result(timeout=120)
     finally:
         sched.stop()
-        engine.prefill_chunk = real_chunk
-        engine.decode = real_decode
+        for name, fn in real.items():
+            setattr(engine, name, fn)
 
-    n_prefills = calls.count("prefill")
-    assert n_prefills >= 4, f"expected many buckets, got {calls}"
-    # no two prefill chunks back-to-back while lane A was decoding
-    first_prefill = calls.index("prefill")
-    last_prefill = len(calls) - 1 - calls[::-1].index("prefill")
-    window = calls[first_prefill:last_prefill]
-    for i in range(len(window) - 1):
-        if window[i] == "prefill":
-            assert window[i + 1] == "decode", (
-                f"consecutive prefill buckets stalled decoding: {calls}"
+    n_chunks = calls.count("prefill") + calls.count("fused")
+    assert n_chunks >= 4, f"expected many buckets, got {calls}"
+    # the admission rode the live chain: decoding never stalled behind it
+    assert calls.count("fused") > 0, f"no fused admission dispatch: {calls}"
+    # any chunk pair without a decode between them must involve a fused
+    # dispatch (which advances the decode lanes itself)
+    for x, y in zip(calls, calls[1:]):
+        if x == "prefill":
+            assert y != "prefill", (
+                f"consecutive sync prefill buckets stalled decoding: {calls}"
             )
 
 
