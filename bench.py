@@ -651,11 +651,15 @@ def _phase_serving_churn(config, small):
     """Poisson-arrival churn against the REAL scheduler: requests join a
     live serving loop mid-generation (the regime the fused prefill+decode
     dispatch exists for) instead of arriving all up front like the
-    `serving` phase's batch. Reports TTFT p50/p95 measured submit -> first
-    stream delta, aggregate `serving_churn_tok_s`, and the pipeline flush
-    count — stall-free admissions keep it ~0 under churn (the remaining
-    flush conditions are drafts, host-exact lanes, and stop/drain; this
-    phase runs speculation off so admission behavior is what's measured).
+    `serving` phase's batch. Reports aggregate `serving_churn_tok_s`, the
+    pipeline flush count (stall-free admissions keep it ~0 under churn;
+    speculation is off so admission behavior is what's measured), and
+    TTFT/TBT percentiles read from the SAME telemetry histogram registry
+    the server's /metrics serves — bench numbers and scraped metrics
+    cannot drift, because they are the same counts. Also writes the span
+    ring as a Perfetto-loadable Chrome trace artifact (BENCH_TRACE_PATH
+    overrides the tmp-dir default) and reports its fused-step slice count
+    — the visible form of "admissions rode the live chain".
     CPU-smoke safe: small lane/request counts, deterministic seeded
     arrivals."""
     import numpy as np
@@ -666,6 +670,7 @@ def _phase_serving_churn(config, small):
         ContinuousBatchingScheduler,
         Request,
     )
+    from distributed_llama_multiusers_tpu.telemetry import Telemetry
 
     n_lanes = 4 if small else 8
     n_requests = 10 if small else 48
@@ -675,39 +680,31 @@ def _phase_serving_churn(config, small):
         config, params, n_lanes=n_lanes, prefill_buckets=(16,)
     )
     tokenizer = _BenchTokenizer(config.vocab_size)
-    sched = ContinuousBatchingScheduler(engine, tokenizer, speculative=False)
+    telemetry = Telemetry()
+    sched = ContinuousBatchingScheduler(
+        engine, tokenizer, speculative=False, telemetry=telemetry
+    )
     # compile everything (incl. the per-bucket fused family) OUTSIDE the
     # measured window: TTFT under churn must not read as XLA compile time
     warmup_engine(engine, spec=False, multi_step=sched.multi_step)
 
     rng = np.random.default_rng(7)
     intervals = rng.exponential(0.05, n_requests)
-    t_submit: dict[int, float] = {}
-    ttft: dict[int, float] = {}
-
-    def make_cb(req):
-        def cb(_delta):
-            if req.id not in ttft:
-                ttft[req.id] = time.perf_counter() - t_submit[req.id]
-        return cb
-
-    reqs = []
-    for i in range(n_requests):
-        r = Request(
+    reqs = [
+        Request(
             prompt="churn benchmark prompt " * 2,
             max_tokens=max_tokens,
             temperature=0.0 if i % 2 == 0 else 0.8,
             seed=200 + i,
         )
-        r.on_delta = make_cb(r)
-        reqs.append(r)
+        for i in range(n_requests)
+    ]
 
     sched.start()
     t0 = time.perf_counter()
     try:
         for r, dt in zip(reqs, intervals):
             time.sleep(dt)
-            t_submit[r.id] = time.perf_counter()
             sched.submit(r)
         for r in reqs:
             r.future.result(timeout=600)
@@ -717,17 +714,45 @@ def _phase_serving_churn(config, small):
     assert all(r.error is None for r in reqs), [r.error for r in reqs]
     toks = sum(len(r.generated_tokens) for r in reqs)
     stats = engine.stats.snapshot()
-    tt = np.sort(np.asarray([ttft[r.id] for r in reqs if r.id in ttft]))
+
+    # percentiles from the serving histogram registry (TTFT = submit ->
+    # first consumed token, observed by the scheduler's telemetry hook)
+    def pct_ms(hist, q):
+        v = hist.quantile(q)
+        return None if v is None else round(v * 1e3, 2)
+
+    # the Perfetto artifact: lanes as tracks, fused/pipelined steps as
+    # slices, admissions/finishes as instants
+    import tempfile
+
+    trace_path = os.environ.get("BENCH_TRACE_PATH") or os.path.join(
+        tempfile.gettempdir(), "dllama_serving_churn_trace.json"
+    )
+    try:
+        doc = telemetry.dump_trace(trace_path)
+        slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        trace_extra = {
+            "serving_churn_trace_path": trace_path,
+            "serving_churn_trace_events": len(doc["traceEvents"]),
+            "serving_churn_trace_fused_slices": sum(
+                1 for e in slices if e["name"] == "step.fused"
+            ),
+            "serving_churn_trace_pipelined_slices": sum(
+                1 for e in slices if e["name"] == "step.pipelined"
+            ),
+        }
+    except OSError as e:  # artifact is evidence, not the headline
+        trace_extra = {"serving_churn_trace_error": f"{type(e).__name__}: {e}"[:200]}
+
     return {
         "serving_churn_tok_s": round(toks / wall, 2),
         "serving_churn_requests": n_requests,
         "serving_churn_lanes": n_lanes,
-        "serving_churn_ttft_ms_p50": (
-            round(float(tt[len(tt) // 2]) * 1e3, 1) if len(tt) else None
-        ),
-        "serving_churn_ttft_ms_p95": (
-            round(float(tt[int(len(tt) * 0.95)]) * 1e3, 1) if len(tt) else None
-        ),
+        "serving_churn_ttft_ms_p50": pct_ms(telemetry.ttft, 0.5),
+        "serving_churn_ttft_ms_p95": pct_ms(telemetry.ttft, 0.95),
+        "serving_churn_tbt_ms_p50": pct_ms(telemetry.tbt, 0.5),
+        "serving_churn_tbt_ms_p95": pct_ms(telemetry.tbt, 0.95),
+        "serving_churn_queue_wait_ms_p95": pct_ms(telemetry.queue_wait, 0.95),
         # the headline churn evidence: admissions rode fused dispatches
         # inside the live chain instead of flushing it
         "serving_churn_pipeline_flushes": stats["pipeline_flushes"],
@@ -737,6 +762,7 @@ def _phase_serving_churn(config, small):
             stats["admission_stall_s"], 4
         ),
         "serving_churn_prefix_hits": stats["prefix_hits"],
+        **trace_extra,
     }
 
 

@@ -6,8 +6,12 @@ in the engine step functions or the scheduler loop silently serializes the
 pipeline on a full [n_lanes, vocab] f32 row every token — the classic
 silent throughput killer on an accelerator behind a high-latency link.
 
-Scope: the decode-path files only (``runtime/engine.py``,
-``runtime/scheduler.py``, ``runtime/spec.py``). Three sub-rules:
+Scope: the decode-path files (``runtime/engine.py``,
+``runtime/scheduler.py``, ``runtime/spec.py``) plus the whole
+``telemetry/`` package — the scheduler hands telemetry hooks values from
+inside the serving loop, so a stray ``np.asarray``/``.item()`` there
+would serialize the decode path from one layer out; telemetry is pure
+stdlib by contract and should never need a waiver. Three sub-rules:
 
 1. **transfer calls** — every ``np.asarray`` / ``np.array`` /
    ``jax.device_get`` call, and every ``.item()`` / ``.tolist()`` /
@@ -39,7 +43,13 @@ from .core import (
     walk_with_ancestors,
 )
 
-SCOPE = ("runtime/engine.py", "runtime/scheduler.py", "runtime/spec.py")
+SCOPE = (
+    "runtime/engine.py", "runtime/scheduler.py", "runtime/spec.py",
+    # the telemetry package rides the serving loop (scheduler hooks);
+    # registered file-by-file because scope matching is suffix-based
+    "telemetry/__init__.py", "telemetry/hub.py", "telemetry/spans.py",
+    "telemetry/metrics.py", "telemetry/trace.py", "telemetry/logs.py",
+)
 CAST_SCOPE = ("runtime/engine.py",)
 
 SYNC_METHODS = {"item", "tolist", "block_until_ready", "all_logits",

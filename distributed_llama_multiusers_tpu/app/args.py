@@ -124,6 +124,13 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "churn. 'off' restores the pre-fused behavior: an "
                         "admission exits the chain to the synchronous "
                         "admit+prefill path (escape hatch)")
+    # observability (telemetry/, docs/OBSERVABILITY.md)
+    p.add_argument("--trace-path", default=None,
+                   help="serving: write the request-lifecycle span ring as "
+                        "Chrome trace-event JSON (Perfetto / "
+                        "chrome://tracing loadable) to this path when the "
+                        "server drains; the live ring is always fetchable "
+                        "at GET /trace and metrics at GET /metrics")
     # train mode (beyond parity — no reference analogue)
     p.add_argument("--data", default=None,
                    help="train: UTF-8 text file tokenized into training batches")

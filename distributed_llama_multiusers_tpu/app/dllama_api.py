@@ -51,6 +51,14 @@ def main(argv=None) -> None:
             scheduler.drain(timeout=30.0)
         finally:
             httpd.shutdown()
+            if args.trace_path:
+                # the drained server's span ring as a Perfetto-loadable
+                # artifact (same document GET /trace served live)
+                try:
+                    scheduler.telemetry.dump_trace(args.trace_path)
+                    log("⭐", f"Trace written to {args.trace_path}")
+                except OSError as e:
+                    log("⚠️", f"trace dump failed: {e}")
 
 
 if __name__ == "__main__":

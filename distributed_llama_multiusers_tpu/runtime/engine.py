@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from ..models.config import LlamaConfig
 from ..models.llama import KVCache, LlamaParams, init_kv_cache, llama_forward
+from ..telemetry.logs import log_event
 from .spec import SPEC_DRAFT
 
 DEFAULT_PREFILL_BUCKETS = (16, 64, 256, 1024)
@@ -1190,3 +1191,30 @@ def warmup_engine(
     reset_workers = getattr(engine, "reset_worker_stats", None)
     if reset_workers is not None:
         reset_workers()
+    # one structured line deployments verify engine config from logs alone
+    # (telemetry/logs.py; the scheduler-side twin is scheduler_start)
+    mesh = getattr(engine, "mesh", None)
+    pipelined = bool(
+        pipeline
+        and getattr(engine, "supports_pipelined", False)
+        and getattr(engine, "pipeline_depth", 0) > 1
+    )
+    log_event(
+        "warmup_engine",
+        n_lanes=n,
+        buckets_warmed=list(engine.prefill_buckets),
+        mesh_shape=dict(mesh.shape) if mesh is not None else None,
+        pipeline_depth=getattr(engine, "pipeline_depth", 0),
+        pipelined=pipelined,
+        # fused admissions need the live pipeline (and were only warmed
+        # under it) — same gate the scheduler's _fused_ok applies, so
+        # this line and scheduler_start cannot contradict each other
+        fused_prefill=bool(
+            pipelined and getattr(engine, "supports_fused_prefill", False)
+        ),
+        multi_step=multi_step,
+        speculative=bool(
+            spec and getattr(engine, "supports_speculative", False)
+        ),
+        seq_len=engine.config.seq_len,
+    )

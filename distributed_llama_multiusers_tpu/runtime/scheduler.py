@@ -45,6 +45,7 @@ from ..serving import (
     drain_scheduler,
     queue_expired,
 )
+from ..telemetry import Telemetry
 from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerChatStops
 from ..utils.seeds import fresh_seed
 from .engine import DEFAULT_TOPP
@@ -93,6 +94,12 @@ class Request:
     finish_reason: str | None = None  # "stop" | "length" | "cancelled" | "timeout"
     submitted_at: float | None = None  # monotonic, stamped by submit()/push()
     admitted_at: float | None = None  # monotonic, stamped at lane claim
+    # telemetry (telemetry/): per-request latency record attached at
+    # submit, and the summary dict (ttft_s, tbt p50/p95, queued_s, ...)
+    # produced at finish — the SAME object the HTTP layer attaches to
+    # completion responses and the JSON request log line carries
+    tel: object = None
+    summary: dict | None = None
     _cancelled: threading.Event = field(default_factory=threading.Event)
 
     def cancel(self) -> None:
@@ -191,6 +198,7 @@ class ContinuousBatchingScheduler:
         deadlines: DeadlinePolicy | None = None,
         pipelined: bool = True,
         fused_prefill: bool = True,
+        telemetry: Telemetry | None = None,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
@@ -255,11 +263,25 @@ class ContinuousBatchingScheduler:
         The default queue is a :class:`~..serving.qos.QosQueue` (unbounded
         unless the caller passes a capacity-bounded one): per-user
         deficit-round-robin fair share and priority classes replace the
-        seed's bare FIFO."""
+        seed's bare FIFO.
+
+        ``telemetry`` (telemetry/): the span tracer + metrics registry +
+        JSON logger hub this scheduler stamps request lifecycles and step
+        slices into; a default hub is built when the caller passes none
+        (host-side only, bounded ring — always on). The server exposes it
+        at ``GET /metrics`` / ``GET /trace``; the bench reports its
+        percentiles. Span stamping never happens inside the pipelined
+        dispatch half (dlint ``pipeline-sync`` pins that): pipelined step
+        slices are recorded by the consume half, one step behind."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.queue = queue_ or QosQueue()
         self.deadlines = deadlines or DeadlinePolicy()
+        self.telemetry = telemetry or Telemetry()
+        # queue-wait histogram source: the queue's own pop-time measurement
+        # when it offers one (reconciles with queue_popped exactly), else
+        # observed at lane-claim time
+        self._observe_wait_at_claim = not self.telemetry.bind_queue(self.queue)
         self.eos_padding = eos_padding
         self.host_sampling = host_sampling
         self.speculative = speculative
@@ -290,6 +312,23 @@ class ContinuousBatchingScheduler:
         self._draining.clear()
         self._thread = threading.Thread(target=self._run, name="batching-loop", daemon=True)
         self._thread.start()
+        # one structured line deployments verify serving config from
+        # (the engine-side twin — mesh shape, buckets warmed — comes from
+        # warmup_engine)
+        engine = self.engine
+        self.telemetry.startup_log(
+            "scheduler_start",
+            n_lanes=engine.n_lanes,
+            pipeline_depth=getattr(engine, "pipeline_depth", 0),
+            pipelined=self.pipelined,
+            fused_prefill=self._fused_ok(),
+            multi_step=self.multi_step,
+            speculative=self.speculative,
+            prefix_min_tokens=self.prefix_min_tokens,
+            queue_capacity=getattr(self.queue, "capacity", None),
+            queue_timeout_s=self.deadlines.queue_timeout_s,
+            request_budget_s=self.deadlines.request_budget_s,
+        )
 
     def stop(self) -> None:
         """Clean shutdown — the reference's loop never terminates (defect (d)).
@@ -325,6 +364,9 @@ class ContinuousBatchingScheduler:
             self._shed_draining()
         if request.submitted_at is None:
             request.submitted_at = time.monotonic()
+        # attach the lifecycle record BEFORE the push: the loop thread may
+        # pop and admit this request before push() even returns here
+        self.telemetry.on_submit(request)
         try:
             self.queue.push(request)
         except AdmissionRejected:
@@ -377,6 +419,7 @@ class ContinuousBatchingScheduler:
         while queued): empty text, typed finish_reason."""
         req.state = RequestState.DONE
         req.finish_reason = reason
+        self.telemetry.on_unadmitted(req, reason)
         if not req.future.done():
             req.future.set_result(req.generated_text)
 
@@ -388,6 +431,7 @@ class ContinuousBatchingScheduler:
         never be retried."""
         req.state = RequestState.FAILED
         req.finish_reason = "cancelled"
+        self.telemetry.on_unadmitted(req, "shed")
         if not req.future.done():
             req.future.set_exception(AdmissionRejected("draining", retry_after_s=5.0))
 
@@ -427,6 +471,11 @@ class ContinuousBatchingScheduler:
         if req is None:
             return None
         now = time.monotonic()
+        if self._observe_wait_at_claim:
+            # bare-FIFO fallback: observe at pop time like the QosQueue
+            # observer does, cancelled/expired pops included, so both
+            # queue kinds feed the histogram the same population
+            self.telemetry.on_queue_pop(req, now)
         if req._cancelled.is_set():
             self._resolve_unadmitted(req, "cancelled")
             return -1
@@ -436,12 +485,14 @@ class ContinuousBatchingScheduler:
             return -1
         req.admitted_at = now
         lane_idx = free.pop(0)
+        self.telemetry.on_admit(req, lane_idx)
         try:
             self._start_request(lane_idx, req)
         except Exception as e:  # tokenization errors fail the request
             req.state = RequestState.FAILED
             req.error = str(e)
             self._lanes[lane_idx] = _Lane()
+            self.telemetry.on_error(req, lane_idx, str(e))
             if not req.future.done():
                 req.future.set_exception(e)
             return -1
@@ -492,6 +543,7 @@ class ContinuousBatchingScheduler:
             if best_lcp >= self.prefix_min_tokens:
                 self.engine.copy_lane(best_lane, lane_idx)
                 start = best_lcp
+                self.telemetry.on_prefix_hit(req, best_lcp)
                 with self.engine.stats.lock:
                     self.engine.stats.prefix_hits += 1
                     self.engine.stats.prefix_tokens_saved += best_lcp
@@ -541,6 +593,7 @@ class ContinuousBatchingScheduler:
         lane = self._lanes[lane_idx]
         req = lane.request
         chunk = lane.pending[: self.engine.max_chunk()]
+        t_chunk = time.perf_counter()
         try:
             logits, greedy, sampled = self.engine.prefill_chunk(
                 lane_idx, chunk, lane.pos,
@@ -551,9 +604,11 @@ class ContinuousBatchingScheduler:
             req.state = RequestState.FAILED
             req.error = str(e)
             self._lanes[lane_idx] = _Lane()
+            self.telemetry.on_error(req, lane_idx, str(e))
             if not req.future.done():
                 req.future.set_exception(e)
             return True
+        self.telemetry.on_prefill_chunk(req, lane_idx, t_chunk, len(chunk))
         lane.pos += len(chunk)
         lane.pending = lane.pending[len(chunk):]
         self._lane_kv[lane_idx].extend(chunk)  # committed: prefix-cacheable
@@ -577,6 +632,10 @@ class ContinuousBatchingScheduler:
         False when the lane finished (EOS or length)."""
         req = lane.request
         req.generated_tokens.append(tok)
+        # per-token stamp: first token observes TTFT, later ones the
+        # inter-token gap (multi-step/spec bursts land near-zero gaps —
+        # that IS when their stream deltas reach the client)
+        self.telemetry.on_token(req)
         self._lane_kv[lane_idx].append(tok)  # its KV write is committed
         lane.drafter.append(tok)
         piece = lane.decoder.decode(tok)
@@ -730,6 +789,7 @@ class ContinuousBatchingScheduler:
                 ok = False  # needs the sync path: flush after this claim
                 break
             admitting[claimed] = lane
+            self.telemetry.on_fused_admit(lane.request)
         if stalled:
             with self.engine.stats.lock:
                 self.engine.stats.admission_stall_s += (
@@ -752,9 +812,9 @@ class ContinuousBatchingScheduler:
         engine's carry); nothing in here may read a device value back, or
         the whole overlap dies — machine-checked by dlint's pipeline-sync.
 
-        Returns ``(lane_idx, lane, final)`` for a fused dispatch (None for
-        a plain one). Chunk bookkeeping — ``lane.pos``, ``lane.pending``,
-        ``_lane_kv`` — commits here at DISPATCH time: the chunk's KV
+        Returns ``(lane_idx, lane, final, n_chunk)`` for a fused dispatch
+        (None for a plain one). Chunk bookkeeping — ``lane.pos``,
+        ``lane.pending``, ``_lane_kv`` — commits here at DISPATCH time: the chunk's KV
         writes execute in dispatch order whether or not the step's outputs
         are ever consumed, so the resident-KV map stays truthful even for
         a request cancelled mid-prompt."""
@@ -802,27 +862,32 @@ class ContinuousBatchingScheduler:
         lane.pos += len(chunk)
         lane.pending = lane.pending[len(chunk):]
         self._lane_kv[target].extend(chunk)  # committed: prefix-cacheable
-        return (target, lane, not lane.pending)
+        return (target, lane, not lane.pending, len(chunk))
 
     def _pipeline_consume(self, live: dict, entry: tuple) -> None:
         """Consume half, one step behind: block on the oldest in-flight
         step's packed token readback and run the host work the synchronous
         loop does inline — stream decode, EOS/stop, cancel/budget checks —
         while the younger dispatches keep the device busy. ``entry`` is
-        ``(step_lanes, fused)`` recorded AT DISPATCH TIME: ``step_lanes``
-        pairs each live lane index with its lane OBJECT — the identity
-        check skips both lanes that finished at an earlier consumed step
-        AND lanes already reclaimed by a NEW request while this step was
-        still in flight (either way the column is junk, and its in-flight
-        KV writes die under the overwrite-before-readable rule).
-        ``fused`` is the dispatch half's ``(lane_idx, lane, final)`` for a
-        fused prefill+decode step, whose extra readback column carries the
-        chunk's boundary token pair: on the FINAL chunk that token is the
-        request's first generated token, committed here exactly one step
-        behind — the same point the synchronous path would have read it."""
+        ``(step_lanes, fused, t_dispatch)`` recorded AT DISPATCH TIME:
+        ``step_lanes`` pairs each live lane index with its lane OBJECT —
+        the identity check skips both lanes that finished at an earlier
+        consumed step AND lanes already reclaimed by a NEW request while
+        this step was still in flight (either way the column is junk, and
+        its in-flight KV writes die under the overwrite-before-readable
+        rule). ``fused`` is the dispatch half's ``(lane_idx, lane, final,
+        n_chunk)`` for a fused prefill+decode step, whose extra readback
+        column carries the chunk's boundary token pair: on the FINAL
+        chunk that token is the request's first generated token,
+        committed here exactly one step behind — the same point the
+        synchronous path would have read it. ``t_dispatch`` is the step's
+        dispatch stamp: the telemetry slice spans dispatch -> this lagged
+        readback, recorded HERE (the consume half) so the dispatch half
+        stays span-free (dlint pipeline-sync)."""
         greedy_np, sampled_np = self.engine.pipeline_consume()
         now = time.monotonic()
-        step_lanes, fused = entry
+        step_lanes, fused, t_dispatch = entry
+        self.telemetry.on_pipelined_step(t_dispatch, fused)
         for i, lane in step_lanes:
             if live.get(i) is not lane:
                 continue  # finished earlier (or lane reclaimed): junk column
@@ -846,7 +911,7 @@ class ContinuousBatchingScheduler:
             else:
                 lane.next_token = int(sampled_np[i])
         if fused is not None:
-            i, lane, final = fused
+            i, lane, final, _n_chunk = fused
             if final and live.get(i) is lane:
                 # prompt complete: adopt the boundary token as the first
                 # generated token (greedy at temp 0, fused-sampled else —
@@ -895,6 +960,10 @@ class ContinuousBatchingScheduler:
                 i: l for i, l in enumerate(self._lanes)
                 if l.request is not None and l.pending and i not in live
             }
+            for l in admitting.values():
+                # sync-admitted leftovers joining the chain: their
+                # remaining chunks ride fused dispatches too
+                self.telemetry.on_fused_admit(l.request)
         # per-lane position of the NEXT dispatch = committed pos + in-flight
         # lag (resynced from the lanes on every entry)
         pl_pos = {i: lane.pos for i, lane in live.items()}
@@ -952,19 +1021,24 @@ class ContinuousBatchingScheduler:
             probe_drafts = True  # entry gates probed already; re-check
             # from the second iteration on (new tokens land per consume)
             while not flush and engine.pipeline_inflight() < depth:
+                # dispatch stamp taken HERE, not inside the dispatch half:
+                # the consume half pairs it with the lagged readback into
+                # the step's trace slice (no tracer call — no lock, no
+                # sync — ever runs inside _pipeline_dispatch itself)
+                t_d = time.perf_counter()
                 fused_info = self._pipeline_dispatch(
                     live, admitting, pl_pos, feed if host_feed else None
                 )
                 host_feed = False
                 dispatched_any = True
-                meta.append((tuple(live.items()), fused_info))
+                meta.append((tuple(live.items()), fused_info, t_d))
                 for i in live:
                     pl_pos[i] += 1
                 if fused_info is not None and fused_info[2]:
                     # final chunk dispatched: the lane joins the decode
                     # half from the NEXT dispatch — the device carry holds
                     # its first token, no host round-trip involved
-                    i, lane, _ = fused_info
+                    i, lane, _, _ = fused_info
                     admitting.pop(i)
                     live[i] = lane
                     pl_pos[i] = lane.pos
@@ -974,6 +1048,7 @@ class ContinuousBatchingScheduler:
         if (live or admitting) and dispatched_any:
             # cut short with lanes still generating or admitting: an actual
             # flush (the natural all-lanes-finished drain is not)
+            self.telemetry.on_flush(len(live), len(admitting))
             with engine.stats.lock:
                 engine.stats.pipeline_flushes += 1
         engine.pipeline_flush()  # ring already drained; drops the carry
@@ -988,6 +1063,9 @@ class ContinuousBatchingScheduler:
                 req.on_delta(delta)
         self._lanes[lane_idx] = _Lane()
         self.engine.reset_lane(lane_idx)
+        # summary/spans/log line BEFORE the future resolves: the HTTP
+        # thread reads req.summary the moment result() returns
+        self.telemetry.on_finish(req, lane_idx, reason)
         if not req.future.done():
             req.future.set_result(req.generated_text)
 
@@ -1144,6 +1222,7 @@ class ContinuousBatchingScheduler:
             h = 0 if draft_len is not None else self._multi_horizon(
                 active, prefilled
             )
+            t_step = time.perf_counter()
             if draft_len is not None:
                 logits, emitted, n_emit = self.engine.decode_spec(
                     tokens, drafts, draft_len, positions, temps, topps, seeds
@@ -1161,6 +1240,11 @@ class ContinuousBatchingScheduler:
                     tokens, positions, temps, topps, seeds,
                     want_logits=host_exact_active,
                 )
+            self.telemetry.on_step(
+                "spec" if draft_len is not None
+                else ("multi" if h > 1 else "sync"),
+                t_step, args={"h": h} if h > 1 else None,
+            )
             # host-exact lanes (global host_sampling mode, or per-request
             # fallback for near-1.0 top-p / very high temperature where the
             # device sampler's top-k truncation would distort): one batched
@@ -1243,5 +1327,6 @@ class ContinuousBatchingScheduler:
                 self._shed_unadmitted(req)
             else:
                 req.state = RequestState.FAILED
+                self.telemetry.on_error(req, None, "scheduler stopped")
                 if not req.future.done():
                     req.future.set_exception(RuntimeError("scheduler stopped"))

@@ -87,9 +87,9 @@ class InferenceParams:
 
 def chat_completion_response(
     model: str, req_id: int, text: str, prompt_tokens: int, completion_tokens: int,
-    finish_reason: str = "stop",
+    finish_reason: str = "stop", summary: dict | None = None,
 ) -> dict:
-    return {
+    out = {
         "id": f"chatcmpl-{req_id}",
         "object": "chat.completion",
         "created": int(time.time()),  # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
@@ -108,21 +108,31 @@ def chat_completion_response(
             "total_tokens": prompt_tokens + completion_tokens,
         },
     }
+    if summary is not None:
+        # per-request telemetry summary (telemetry/spans.py RequestTrace;
+        # docs/OBSERVABILITY.md): ttft_s, tbt p50/p95, queued_s, ... —
+        # the same dict the server's per-request JSON log line carries
+        out["summary"] = summary
+    return out
 
 
 def chat_chunk_response(
-    model: str, req_id: int, delta: str | None, done: bool, finish_reason: str = "stop"
+    model: str, req_id: int, delta: str | None, done: bool,
+    finish_reason: str = "stop", summary: dict | None = None,
 ) -> dict:
     choice: dict = {"index": 0, "delta": {}, "finish_reason": finish_reason if done else None}
     if delta:
         choice["delta"] = {"content": delta}
-    return {
+    out = {
         "id": f"chatcmpl-{req_id}",
         "object": "chat.completion.chunk",
         "created": int(time.time()),  # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
         "model": model,
         "choices": [choice],
     }
+    if done and summary is not None:
+        out["summary"] = summary  # terminal chunk only, same dict as non-stream
+    return out
 
 
 def parse_completion_prompt(body: dict) -> str:
@@ -148,9 +158,9 @@ def parse_completion_prompt(body: dict) -> str:
 
 def completion_response(
     model: str, req_id: int, text: str, prompt_tokens: int, completion_tokens: int,
-    finish_reason: str = "stop",
+    finish_reason: str = "stop", summary: dict | None = None,
 ) -> dict:
-    return {
+    out = {
         "id": f"cmpl-{req_id}",
         "object": "text_completion",
         "created": int(time.time()),  # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
@@ -165,12 +175,16 @@ def completion_response(
             "total_tokens": prompt_tokens + completion_tokens,
         },
     }
+    if summary is not None:
+        out["summary"] = summary  # per-request telemetry (OBSERVABILITY.md)
+    return out
 
 
 def completion_chunk_response(
-    model: str, req_id: int, delta: str | None, done: bool, finish_reason: str = "stop"
+    model: str, req_id: int, delta: str | None, done: bool,
+    finish_reason: str = "stop", summary: dict | None = None,
 ) -> dict:
-    return {
+    out = {
         "id": f"cmpl-{req_id}",
         "object": "text_completion",
         "created": int(time.time()),  # dlint: ok[clock] 'created' is an absolute unix timestamp by OpenAI API contract
@@ -183,6 +197,9 @@ def completion_chunk_response(
             }
         ],
     }
+    if done and summary is not None:
+        out["summary"] = summary  # terminal chunk only, same dict as non-stream
+    return out
 
 
 def models_response(model: str) -> dict:

@@ -13,6 +13,15 @@ its own thread; all of them submit into the shared RequestQueue and their
 generations proceed concurrently in the continuous batch. SSE streaming
 (``"stream": true``) is supported — upstream shipped the chunk types but
 never wired them (api-types.hpp:45-57).
+
+Observability surface (telemetry/, docs/OBSERVABILITY.md): ``GET /metrics``
+serves Prometheus text bridged from the same snapshot ``GET /stats``
+returns (the two reconcile by construction), ``GET /trace`` serves the
+span ring as Perfetto-loadable Chrome trace JSON, completion responses
+carry the per-request summary (ttft_s, tbt p50/p95, queued_s, ...), and
+every error payload — 400/500 JSON and mid-stream SSE error events —
+names the ``request_id``, so a streamed failure correlates with the
+server's per-request JSON log line.
 """
 
 from __future__ import annotations
@@ -34,6 +43,7 @@ class ApiServer:
         self.model_name = model_name
         self.chat_template = chat_generator_for(tokenizer, template_type)
         self._httpd: ThreadingHTTPServer | None = None
+        self._fallback_tel = None  # see _telemetry()
 
     # -- request handling ---------------------------------------------------
 
@@ -106,9 +116,13 @@ class ApiServer:
                     # were committed — too late for a 503 status line, so end
                     # the stream with a terminal "cancelled" chunk instead
                     req.finish_reason = "cancelled"
+                # terminal chunk carries the SAME per-request summary the
+                # non-streaming response does (one producer: the scheduler's
+                # telemetry finish hook), so stream clients are not blind
                 send_chunk(
                     chunk_fn(
-                        self.model_name, req.id, None, True, req.finish_reason or "stop"
+                        self.model_name, req.id, None, True,
+                        req.finish_reason or "stop", summary=req.summary,
                     )
                 )
             except (BrokenPipeError, ConnectionError, OSError):
@@ -121,7 +135,7 @@ class ApiServer:
         text = req.future.result()
         return response_fn(
             self.model_name, req.id, text, req.n_prompt_tokens, len(req.generated_tokens),
-            req.finish_reason or "stop",
+            req.finish_reason or "stop", summary=req.summary,
         )
 
     def handle_models(self) -> dict:
@@ -184,7 +198,34 @@ class ApiServer:
         qos = getattr(sched, "qos_stats", None)
         if callable(qos):  # queue depth/wait/rejections, timeouts, drain
             out.update(qos())
+        tel = self._telemetry()
+        if tel is not None:  # ring occupancy/eviction: a truncated /trace
+            out.update(tel.tracer.counts())  # window is visible, not silent
         return out
+
+    def _telemetry(self):
+        """The scheduler's telemetry hub (telemetry/), or a lazily built
+        standalone one for custom schedulers without it — /metrics then
+        still serves the bridged /stats gauges."""
+        tel = getattr(self.scheduler, "telemetry", None)
+        if tel is None:
+            if self._fallback_tel is None:
+                from ..telemetry import Telemetry
+
+                self._fallback_tel = Telemetry()
+            tel = self._fallback_tel
+        return tel
+
+    def handle_metrics(self) -> str:
+        """Prometheus text exposition: the native latency histograms and
+        request counters plus every /stats field bridged as a
+        ``dllama_stats_*`` gauge — sampled from the same snapshot, so the
+        two endpoints reconcile (docs/OBSERVABILITY.md)."""
+        return self._telemetry().render_prometheus(bridge=self.handle_stats())
+
+    def handle_trace(self) -> dict:
+        """The span ring as Chrome trace-event JSON (Perfetto loadable)."""
+        return self._telemetry().chrome_trace()
 
     # -- plumbing -----------------------------------------------------------
 
@@ -203,10 +244,16 @@ class ApiServer:
                 self.send_header("Access-Control-Allow-Headers", "Content-Type, Authorization")
 
             def _json(self, code: int, payload: dict, headers: dict | None = None):
-                data = json.dumps(payload).encode()
+                self._raw(
+                    code, json.dumps(payload).encode(), "application/json",
+                    headers,
+                )
+
+            def _raw(self, code: int, data: bytes, content_type: str,
+                     headers: dict | None = None):
                 self.send_response(code)
                 self._cors()
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
@@ -233,6 +280,16 @@ class ApiServer:
                     self._json(200, api.handle_models())
                 elif self.path == "/stats":
                     self._json(200, api.handle_stats())
+                elif self.path == "/metrics":
+                    # Prometheus text exposition format (the version the
+                    # format spec names; scrapers key on it)
+                    self._raw(
+                        200, api.handle_metrics().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/trace":
+                    # Chrome trace-event JSON: save and load in Perfetto
+                    self._json(200, api.handle_trace())
                 elif self.path in ("/", "/health"):
                     # readiness: flips to 503 during drain so load balancers
                     # stop routing here while in-flight work finishes
@@ -267,13 +324,25 @@ class ApiServer:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._json(400, {"error": f"bad request: {e}"})
                     return
+                # request id in EVERY failure payload once a Request exists
+                # (satellite: a streamed failure must correlate with the
+                # server's per-request log lines); None before build_fn
+                # succeeds — those are input errors with no request yet
+                req = None
+
+                def err(payload: dict) -> dict:
+                    if req is not None:
+                        payload["request_id"] = req.id
+                    return payload
+
                 try:
                     if body.get("stream"):
                         # validate AND submit BEFORE committing SSE headers so
                         # bad input still gets a proper 400 and a shed request
                         # (queue full / draining) a proper 429/503
                         prepared = build_fn(body, streaming=True)
-                        api.scheduler.submit(prepared[0])
+                        req = prepared[0]
+                        api.scheduler.submit(req)
                         try:
                             self.send_response(200)
                             self._cors()
@@ -285,7 +354,7 @@ class ApiServer:
                             # client vanished between submit and the header
                             # commit: no pump will ever run, so cancel or the
                             # lane generates max_tokens into an orphaned queue
-                            prepared[0].cancel()
+                            req.cancel()
                             raise
 
                         def send_chunk(payload: dict):
@@ -298,16 +367,18 @@ class ApiServer:
                         except (BrokenPipeError, ConnectionError, OSError):
                             return  # client gone; request already cancelled
                         except Exception as e:  # headers already sent: SSE error event
-                            send_chunk({"error": str(e)})
+                            send_chunk(err({"error": str(e)}))
                             self.wfile.write(b"data: [DONE]\n\n")
                     else:
-                        self._json(200, handle_fn(body))
+                        prepared = build_fn(body, streaming=False)
+                        req = prepared[0]
+                        self._json(200, handle_fn(body, prepared=prepared))
                 except AdmissionRejected as e:  # shed before any headers
                     self._reject(e)
                 except ValueError as e:
-                    self._json(400, {"error": str(e)})
+                    self._json(400, err({"error": str(e)}))
                 except Exception as e:  # generation failure
-                    self._json(500, {"error": str(e)})
+                    self._json(500, err({"error": str(e)}))
 
         httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd = httpd

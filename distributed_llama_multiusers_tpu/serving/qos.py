@@ -149,6 +149,10 @@ class QosQueue:
         self._wait_s_total = 0.0
         self._recent_waits: deque[float] = deque(maxlen=64)
         self._max_depth = 0
+        # optional pop-time wait observer (telemetry queue-wait histogram);
+        # set once before serving via set_wait_observer, invoked OUTSIDE
+        # the queue lock — not in _dlint_guarded_by by design
+        self._on_pop_wait: Callable[[float], None] | None = None
 
     # -- RequestQueue-compatible surface ------------------------------------
 
@@ -183,6 +187,7 @@ class QosQueue:
         """Next request by (priority, per-user DRR); ``None`` on timeout.
         ``timeout=None`` blocks until a request arrives (Queue semantics);
         the scheduler's idle loop parks here instead of spinning."""
+        wait = None
         with self._not_empty:
             if self._depth == 0 and timeout is not None:
                 deadline = time.monotonic() + timeout
@@ -201,7 +206,20 @@ class QosQueue:
                 wait = max(0.0, time.monotonic() - t0)
                 self._wait_s_total += wait
                 self._recent_waits.append(wait)
-            return req
+        # observer runs OUTSIDE the queue lock: a histogram bump must never
+        # extend the critical section every submit()/pop() contends on
+        observer = self._on_pop_wait
+        if wait is not None and observer is not None:
+            observer(wait)
+        return req
+
+    def set_wait_observer(self, observer: Callable[[float], None] | None) -> None:
+        """Install a callback invoked with each popped request's queue
+        wait (seconds) — the telemetry queue-wait histogram feed, so the
+        histogram's count reconciles with ``queue_popped`` exactly. Call
+        before serving starts; the callback runs on the scheduler thread,
+        outside the queue lock, and must not touch the queue."""
+        self._on_pop_wait = observer
 
     def empty(self) -> bool:
         """Advisory emptiness (racy by nature, same contract as the FIFO)."""

@@ -1,0 +1,292 @@
+"""Telemetry hub: the one object the scheduler/server/bench share.
+
+Bundles the span tracer (spans.py), the metrics registry (metrics.py)
+with the standard serving instruments pre-registered, and the JSON
+logger (logs.py), and exposes the lifecycle hooks the scheduler calls:
+
+    submit -> on_submit          (queued instant, RequestTrace attached)
+    admit  -> on_admit           (queued slice, queue-wait histogram)
+    chunk  -> on_prefill_chunk   (lane slice, step-duration histogram)
+    token  -> on_token           (TTFT on first, inter-token gaps after)
+    step   -> on_step / on_pipelined_step  (pipeline-track slices)
+    end    -> on_finish / on_unadmitted / on_error  (summary, counters,
+              one JSON log line, finish instant)
+
+Design constraint, inherited from the async pipeline: NO hook runs
+inside the pipelined dispatch half. Dispatch→consume step slices are
+recorded by ``on_pipelined_step`` from the scheduler's consume half, one
+step behind, where the host is already blocking on the lagged readback —
+dlint's ``pipeline-sync`` check stays green because the dispatch half
+never calls in here.
+
+Exposition: ``render_prometheus(bridge=stats_dict)`` re-publishes the
+``/stats`` payload as ``dllama_stats_*`` gauges next to the native
+histograms/counters, sampled from the SAME snapshot the JSON endpoint
+serves — so ``/metrics`` and ``/stats`` reconcile by construction.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .logs import JsonLogger, default_logger
+from .metrics import LATENCY_BUCKETS_S, MetricsRegistry
+from .spans import RequestTrace, SpanTracer
+from .trace import dump_chrome_trace, tracer_chrome_trace
+
+STATS_PREFIX = "dllama_stats_"
+
+
+class Telemetry:
+    def __init__(
+        self,
+        tracer: SpanTracer | None = None,
+        registry: MetricsRegistry | None = None,
+        logger: JsonLogger | None = None,
+        trace_capacity: int = 16384,
+    ):
+        self.tracer = tracer or SpanTracer(capacity=trace_capacity)
+        self.registry = registry or MetricsRegistry()
+        self.logger = logger or default_logger()
+        reg = self.registry
+        self.ttft = reg.histogram(
+            "dllama_ttft_seconds",
+            "submit -> first consumed token, per request",
+            LATENCY_BUCKETS_S,
+        )
+        self.tbt = reg.histogram(
+            "dllama_time_between_tokens_seconds",
+            "gap between consecutive consumed tokens, per lane",
+            LATENCY_BUCKETS_S,
+        )
+        self.queue_wait = reg.histogram(
+            "dllama_queue_wait_seconds",
+            "submit -> queue pop, per popped request (pops that resolve "
+            "cancelled/expired without claiming a lane included)",
+            LATENCY_BUCKETS_S,
+        )
+        self.step_duration = reg.histogram(
+            "dllama_step_duration_seconds",
+            "one engine dispatch: prefill chunk, decode step (sync/spec/"
+            "multi horizon), or pipelined dispatch->lagged-consume span",
+            LATENCY_BUCKETS_S,
+        )
+        self.requests_finished = reg.counter(
+            "dllama_requests_finished_total",
+            "finished requests by finish_reason (shed = drain-flushed, "
+            "error = failed before generating)",
+        )
+        self.tokens_generated = reg.counter(
+            "dllama_tokens_generated_total", "tokens consumed across lanes"
+        )
+        self.overlap_fraction = reg.gauge(
+            "dllama_overlap_fraction",
+            "overlap_s / (overlap_s + decode_s): fraction of engine decode "
+            "wall-time the async pipeline hid behind device execution",
+        )
+
+    # -- queue binding -------------------------------------------------------
+
+    def bind_queue(self, queue) -> bool:
+        """Feed the queue-wait histogram from the queue's own pop-time
+        measurement when it offers one (QosQueue.set_wait_observer), so
+        the histogram's count reconciles with ``queue_popped`` exactly.
+        Returns False when the queue can't (bare FIFO) — the scheduler
+        then observes at claim time instead."""
+        setter = getattr(queue, "set_wait_observer", None)
+        if setter is None:
+            return False
+        setter(self.queue_wait.observe)
+        return True
+
+    # -- request lifecycle hooks --------------------------------------------
+
+    @staticmethod
+    def trace_of(req) -> RequestTrace:
+        tel = getattr(req, "tel", None)
+        if tel is None:
+            tel = req.tel = RequestTrace(getattr(req, "submitted_at", None))
+        return tel
+
+    def on_submit(self, req) -> None:
+        tel = self.trace_of(req)
+        self.tracer.instant("submitted", "queue", ts=tel.span_t0,
+                            req_id=req.id)
+
+    def on_admit(self, req, lane: int) -> None:
+        tel = self.trace_of(req)
+        tel.admitted_at = req.admitted_at
+        tel.lane = lane
+        now_pc = self.tracer.now()
+        self.tracer.slice("queued", "queue", tel.span_t0, now_pc,
+                          req_id=req.id, args={"lane": lane})
+        tel.span_t0 = now_pc  # the generate slice starts here
+
+    def on_queue_pop(self, req, now: float) -> None:
+        """Fallback queue-wait observation for queues WITHOUT a pop-time
+        observer (bare FIFO): called by the scheduler right after every
+        pop — cancelled/expired pops included — so both queue kinds feed
+        the histogram the same population."""
+        t0 = getattr(req, "submitted_at", None)
+        if t0 is not None:
+            self.queue_wait.observe(max(0.0, now - t0))
+
+    def on_prefix_hit(self, req, tokens_saved: int) -> None:
+        self.trace_of(req).prefix_saved = int(tokens_saved)
+
+    def on_fused_admit(self, req) -> None:
+        """The request's prompt chunks are riding fused dispatches inside
+        the live chain (claimed in-chain, or joined the chain with chunks
+        still pending)."""
+        self.trace_of(req).fused_admitted = True
+
+    def on_prefill_chunk(self, req, lane: int, t0: float, n_tokens: int,
+                         fused: bool = False) -> None:
+        now_pc = self.tracer.now()
+        self.tracer.slice(
+            "prefill.fused" if fused else "prefill.sync", f"lane{lane}",
+            t0, now_pc, req_id=req.id, args={"tokens": n_tokens},
+        )
+        if not fused:
+            # fused chunks ride a pipelined dispatch that on_pipelined_step
+            # already times; observing both would double-count the span
+            self.step_duration.observe(max(0.0, now_pc - t0))
+
+    def on_token(self, req, now: float | None = None) -> None:
+        """One consumed token (``now`` = time.monotonic()). First token
+        observes TTFT; every later one observes the inter-token gap."""
+        tel = self.trace_of(req)
+        if now is None:
+            now = time.monotonic()
+        first = tel.first_token_at is None
+        tel.on_token(now)
+        self.tokens_generated.inc()
+        if first:
+            if tel.ttft_s is not None:
+                self.ttft.observe(tel.ttft_s)
+        else:
+            self.tbt.observe(tel.gaps[-1])
+
+    # -- step hooks ----------------------------------------------------------
+
+    def on_step(self, kind: str, t0: float, args: dict | None = None) -> None:
+        """One synchronous engine dispatch (kind: sync/spec/multi)."""
+        now_pc = self.tracer.now()
+        self.tracer.slice(f"step.{kind}", "pipeline", t0, now_pc, args=args)
+        self.step_duration.observe(max(0.0, now_pc - t0))
+
+    def on_pipelined_step(self, t_dispatch: float, fused_info=None) -> None:
+        """One pipelined step, recorded at CONSUME time (one step behind):
+        the slice spans dispatch -> lagged readback completion. For a
+        fused prefill+decode step, ``fused_info`` is the scheduler's
+        ``(lane_idx, lane, final, n_chunk)`` and the admitting lane also
+        gets a ``prefill.fused`` slice on its own track."""
+        now_pc = self.tracer.now()
+        if fused_info is None:
+            self.tracer.slice("step.pipelined", "pipeline", t_dispatch,
+                              now_pc)
+        else:
+            lane_idx, lane, final, n_chunk = fused_info
+            req = lane.request
+            req_id = getattr(req, "id", None)
+            self.tracer.slice(
+                "step.fused", "pipeline", t_dispatch, now_pc,
+                req_id=req_id, args={"chunk": n_chunk, "final": final},
+            )
+            if req is not None:
+                self.on_prefill_chunk(req, lane_idx, t_dispatch, n_chunk,
+                                      fused=True)
+        self.step_duration.observe(max(0.0, now_pc - t_dispatch))
+
+    def on_flush(self, live: int, admitting: int) -> None:
+        self.tracer.instant("pipeline.flush", "pipeline",
+                            args={"live": live, "admitting": admitting})
+
+    # -- request endings -----------------------------------------------------
+
+    def _summarize(self, req, reason: str | None,
+                   error: str | None = None) -> dict:
+        tel = self.trace_of(req)
+        summary = tel.summary(req, reason)
+        if error is not None:
+            summary["error"] = error
+        req.summary = summary
+        self.logger.emit("request", **summary)
+        return summary
+
+    def on_finish(self, req, lane: int, reason: str | None) -> None:
+        """A request that held a lane ended (stop/length/cancel/timeout)."""
+        tel = self.trace_of(req)
+        track = f"lane{lane}"
+        self.tracer.slice("generate", track, tel.span_t0, req_id=req.id,
+                          args={"finish_reason": reason})
+        self.tracer.instant(f"finish.{reason}", track, req_id=req.id)
+        self.requests_finished.inc(finish_reason=str(reason))
+        self._summarize(req, reason)
+
+    def on_unadmitted(self, req, reason: str) -> None:
+        """A request resolved without ever claiming a lane (queue timeout,
+        cancel while queued, drain shed)."""
+        tel = self.trace_of(req)
+        self.tracer.slice("queued", "queue", tel.span_t0, req_id=req.id,
+                          args={"finish_reason": reason})
+        self.tracer.instant(f"finish.{reason}", "queue", req_id=req.id)
+        self.requests_finished.inc(finish_reason=reason)
+        self._summarize(req, reason)
+
+    def on_error(self, req, lane: int | None, error: str) -> None:
+        """A request failed before generating (tokenization/engine error).
+        The error string rides the summary BEFORE the log line is emitted,
+        so the request's log record carries the reason the 500 names."""
+        track = "queue" if lane is None else f"lane{lane}"
+        self.tracer.instant("finish.error", track, req_id=req.id,
+                            args={"error": error[:200]})
+        self.requests_finished.inc(finish_reason="error")
+        self._summarize(req, "error", error=error[:200])
+
+    # -- startup -------------------------------------------------------------
+
+    def startup_log(self, event: str, **fields) -> None:
+        """One structured line deployments verify config from (satellite:
+        mesh shape / buckets / pipeline depth / fused on-off in logs)."""
+        self.logger.emit(event, **fields)
+
+    # -- exposition ----------------------------------------------------------
+
+    def bridge_stats(self, stats: dict) -> None:
+        """Republish a ``/stats`` payload as ``dllama_stats_*`` gauges
+        (dict-valued histogram counters become labelled gauges), plus the
+        derived overlap-fraction gauge. Values land verbatim, so a scrape
+        reconciles with the JSON endpoint field-for-field."""
+        reg = self.registry
+        for key, value in stats.items():
+            if value is None:
+                continue
+            name = STATS_PREFIX + key
+            if isinstance(value, bool):
+                reg.gauge(name).set(1.0 if value else 0.0)
+            elif isinstance(value, (int, float)):
+                reg.gauge(name).set(float(value))
+            elif isinstance(value, dict):
+                g = reg.gauge(name)
+                for k, v in value.items():
+                    if isinstance(v, (int, float)):
+                        g.set(float(v), key=str(k))
+        overlap = float(stats.get("overlap_s") or 0.0)
+        decode = float(stats.get("decode_s") or 0.0)
+        if overlap + decode > 0:
+            self.overlap_fraction.set(overlap / (overlap + decode))
+
+    def render_prometheus(self, bridge: dict | None = None) -> str:
+        if bridge:
+            self.bridge_stats(bridge)
+        return self.registry.render()
+
+    def chrome_trace(self) -> dict:
+        return tracer_chrome_trace(self.tracer)
+
+    def dump_trace(self, path: str) -> dict:
+        doc = dump_chrome_trace(self.tracer, path)
+        self.logger.emit("trace_dump", path=path,
+                         events=len(doc["traceEvents"]))
+        return doc

@@ -1,0 +1,60 @@
+"""Structured one-line JSON logs: startup config and per-request summary.
+
+Deployments grep these, log pipelines parse them, and the ROADMAP-scale
+fleet correlates them with traces by ``request_id`` — so every line is a
+single JSON object on stderr (never stdout: the CLI prints generated
+text there) with a fixed envelope:
+
+    {"event": "...", "ts": <unix seconds>, "mono_s": <monotonic>, ...}
+
+``ts`` is the one sanctioned wall-clock read in the telemetry package —
+an absolute timestamp leaving the process, the same category as the API
+``created`` fields (waived under dlint's ``clock`` check); everything
+that measures a *duration* uses the monotonic fields.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+
+class JsonLogger:
+    """One JSON object per line to ``stream`` (default stderr). A module
+    lock serializes lines so concurrent HTTP threads never interleave
+    bytes mid-record."""
+
+    def __init__(self, stream=None):
+        self.stream = stream
+        self._log_lock = threading.Lock()
+
+    def emit(self, event: str, **fields) -> None:
+        rec = {
+            "event": event,
+            # dlint: ok[clock] absolute wall timestamp leaving the process in the log line (durations use mono_s)
+            "ts": round(time.time(), 3),
+            "mono_s": round(time.monotonic(), 6),
+        }
+        rec.update(fields)
+        line = json.dumps(rec, default=str)
+        stream = self.stream if self.stream is not None else sys.stderr
+        with self._log_lock:
+            try:
+                print(line, file=stream, flush=True)
+            except (ValueError, OSError):
+                pass  # closed stream at interpreter teardown: drop the line
+
+
+_DEFAULT = JsonLogger()
+
+
+def default_logger() -> JsonLogger:
+    return _DEFAULT
+
+
+def log_event(event: str, **fields) -> None:
+    """Emit on the process-default logger (startup lines from code that
+    has no Telemetry instance in hand, e.g. ``warmup_engine``)."""
+    _DEFAULT.emit(event, **fields)

@@ -265,6 +265,94 @@ def test_host_sync_implicit_bool_on_compiled_step_output(tmp_path):
     assert "implicit bool" in findings[0].message
 
 
+def test_host_sync_covers_telemetry_package(tmp_path):
+    """PR-5 satellite: the telemetry package is registered under host-sync
+    — a device->host transfer construct added to a telemetry hot path
+    (the scheduler calls these hooks from inside the serving loop) is a
+    finding there exactly like in runtime/."""
+    bad = """
+        import numpy as np
+
+        def on_token(tokens):
+            return np.asarray(tokens)
+    """
+    findings = run_on(tmp_path, {"telemetry/spans.py": bad})
+    assert checks_of(findings) == ["host-sync"]
+    # metrics.py is scoped too; .item() is the other transfer spelling
+    findings = run_on(tmp_path / "b", {"telemetry/metrics.py": """
+        def observe(h, v):
+            h.observe(v.item())
+    """})
+    assert checks_of(findings) == ["host-sync"]
+    # the clean shape: host floats in, host floats out — no findings
+    clean = run_on(tmp_path / "c", {"telemetry/hub.py": """
+        import time
+
+        def on_step(tracer, t0):
+            tracer.slice("step.sync", "pipeline", t0, time.perf_counter())
+    """})
+    assert clean == []
+
+
+def test_clock_covers_telemetry_files(tmp_path):
+    """clock is package-wide, telemetry included: a wall-clock duration in
+    a telemetry file is a finding; the one sanctioned absolute-timestamp
+    site (the JSON log envelope) carries a waiver in the real tree."""
+    findings = run_on(tmp_path, {"telemetry/logs.py": """
+        import time
+
+        def stamp():
+            return time.time()
+    """})
+    assert checks_of(findings) == ["clock"]
+
+
+def test_real_telemetry_guard_decls_are_collected():
+    """The SpanTracer/metrics declarations reach the guarded-by checker
+    (same rot-guard as the EngineStats/QosQueue assertion above)."""
+    import ast
+
+    from distributed_llama_multiusers_tpu.analysis.core import Project, SourceFile
+    from distributed_llama_multiusers_tpu.analysis.lock_check import GuardedByChecker
+
+    project = Project()
+    checker = GuardedByChecker()
+    for rel in ("telemetry/spans.py", "telemetry/metrics.py"):
+        p = PACKAGE_ROOT / rel
+        sf = SourceFile(
+            path=p, display=rel, text=p.read_text(), tree=ast.parse(p.read_text())
+        )
+        checker.collect(sf, project)
+    assert "_trace_ring" in project.guarded
+    assert "_hist_counts" in project.guarded
+    assert "_reg_metrics" in project.guarded
+    assert project.guarded["_trace_dropped"][0] == frozenset({"_trace_lock"})
+
+
+def test_guarded_by_flags_unlocked_telemetry_ring_access(tmp_path):
+    """A new unlocked touch of the tracer ring state is a finding — the
+    telemetry satellite's known-bad fixture."""
+    findings = run_on(tmp_path, {"telemetry/spans.py": """
+        import threading
+
+        class SpanTracer:
+            _dlint_guarded_by = {("_trace_lock",): ("_trace_ring",)}
+
+            def __init__(self):
+                self._trace_lock = threading.Lock()
+                self._trace_ring = []
+
+            def bad_append(self, ev):
+                self._trace_ring.append(ev)
+
+            def good_append(self, ev):
+                with self._trace_lock:
+                    self._trace_ring.append(ev)
+    """})
+    assert checks_of(findings) == ["guarded-by"]
+    assert "_trace_ring" in findings[0].message
+
+
 # -- pipeline-sync -----------------------------------------------------------
 
 
