@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint sanitize clean
+.PHONY: all native test verify lint lockgraph sanitize clean
 
 all: native
 
@@ -30,15 +30,26 @@ verify: native
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' $$log | tr -cd . | wc -c); \
 	rm -f $$log; exit $$rc
 
-# Static project-invariant gate (docs/LINT.md): lock discipline on
-# declared guarded state, host-sync transfers in the decode path, clock
-# hygiene, condvar/thread hygiene, sharding-axis names. Companion to
-# `verify` — run BOTH before shipping runtime/serving changes; lint is
-# pure stdlib (no jax, no native build), so it's the cheap first gate.
-# tests/test_dlint.py runs the same analysis inside tier-1, so `verify`
-# fails on findings too; this target is the fast direct entry point.
+# Static project-invariant gate (docs/LINT.md): cross-file lock-order
+# graph, blocking-under-lock, guarded-attr atomicity, pod-broadcast
+# pairing, lock discipline on declared guarded state, host-sync
+# transfers in the decode path, clock hygiene, condvar/thread hygiene,
+# sharding-axis names. Companion to `verify` — run BOTH before shipping
+# runtime/serving changes; lint is pure stdlib (no jax, no native
+# build), so it's the cheap first gate. tests/test_dlint.py runs the
+# same analysis inside tier-1, so `verify` fails on findings too; this
+# target is the fast direct entry point. Under GitHub Actions the
+# findings render as ::error workflow annotations on the PR diff.
+LINT_FORMAT := $(if $(filter true,$(GITHUB_ACTIONS)),--format github,)
 lint:
-	python -m distributed_llama_multiusers_tpu.analysis
+	python -m distributed_llama_multiusers_tpu.analysis $(LINT_FORMAT)
+
+# Reviewer aid for new lock/broadcast code (ROADMAP items 2-4): the
+# statically computed lock-order DAG, DOT on stdout (waived edges
+# dashed). Pipe into `dot -Tsvg` or read directly — new edges are what
+# to eyeball in review.
+lockgraph:
+	python -m distributed_llama_multiusers_tpu.analysis --graph
 
 # ASan+UBSan gate for the native codec (the reference's sanitizer-CI
 # analogue, SURVEY.md §5.2): rebuilds the .so instrumented and reruns the

@@ -8,15 +8,29 @@ reviewer memory. This package machine-checks them — the Python/JAX
 analogue of the reference repo's sanitizer CI for C++ (SURVEY.md §5.2,
 mirrored by ``make sanitize``).
 
-Six checks (docs/LINT.md has the full contract and waiver policy):
+Ten checks (docs/LINT.md has the full contract and waiver policy). The
+four ``lock-*``/``pod-*`` checks are the v2 cross-file concurrency layer:
+they share one lock model (lockgraph.py) of every class-qualified lock in
+the package, and the statically computed lock-order graph doubles as the
+runtime witness's seed (lockcheck.py, ``DLLAMA_LOCKCHECK=1``).
 
-- ``guarded-by``    — lock discipline for declared shared attributes
-- ``host-sync``     — explicit, waived device->host transfers in decode
-- ``pipeline-sync`` — NO host syncs at all in the async-pipeline dispatch
+- ``lock-order``     — the cross-file "held while acquiring" graph over
+  declared locks stays acyclic (one level of intra-package calls
+  included); also pins witness-name/declaration agreement
+- ``guarded-by``     — lock discipline for declared shared attributes
+- ``lock-blocking``  — no blocking construct (I/O, waits, sends,
+  broadcasts, observer calls, subprocesses) under a declared lock
+- ``lock-atomicity`` — guarded read-modify-write may not straddle a
+  lock release within one function
+- ``pod-broadcast``  — multihost proxy methods: validate, broadcast,
+  compute — nothing raises/returns between a packet and its paired
+  engine call
+- ``host-sync``      — explicit, waived device->host transfers in decode
+- ``pipeline-sync``  — NO host syncs at all in the async-pipeline dispatch
   half (engine.decode_pipelined / scheduler._pipeline_dispatch)
-- ``clock``         — no wall clock for durations/deadlines/seeds
-- ``condvar``       — predicate loops, no busy-polls, joined threads
-- ``sharding-axis`` — PartitionSpec/collective axes declared by the mesh
+- ``clock``          — no wall clock for durations/deadlines/seeds
+- ``condvar``        — predicate loops, no busy-polls, joined threads
+- ``sharding-axis``  — PartitionSpec/collective axes declared by the mesh
 
 Usage::
 

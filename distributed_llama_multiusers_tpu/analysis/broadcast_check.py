@@ -1,0 +1,115 @@
+"""pod-broadcast: every control packet pairs with exactly one engine call.
+
+Scope: ``parallel/multihost.py`` (and fixture files with that suffix).
+The pod control plane's deadlock rule (multihost.py's RootControlEngine):
+workers replay every broadcast packet with a blocking engine call, so on
+the root each ``self._plane.send_*`` broadcast must be followed —
+unconditionally — by its paired ``self._engine.<method>`` call. Two ways
+a proxy method can break the pod:
+
+1. a ``raise`` (or an early ``return``) reachable BETWEEN the broadcast
+   and the paired engine call: the packet went out, every worker enters
+   the collective program, the root never dispatches its half — the pod
+   hangs in ICI collectives with nothing to time out;
+2. validation placed after the broadcast: the argument check that should
+   have rejected the call locally now fires with the packet already on
+   the wire, which is case 1 wearing a different hat.
+
+So: validate first, broadcast second, compute third. This check walks
+every method of every class in scope that broadcasts, takes each
+broadcast site, finds its paired engine call (the next
+``self._engine.*`` call in source order — a ``return`` whose expression
+CONTAINS the engine call is the pair, not an escape), and flags any
+``raise`` or ``return`` in between. A broadcast with no pair at all
+(OP_STOP, stats reset, pipeline flush replay no device program) is legal,
+but a ``raise`` after it is still flagged: the packet is already out.
+
+Waive (``ok[pod-broadcast] reason``) only for ops documented to replay
+nothing on the worker side where the post-send code cannot desync.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Checker, Finding, Project, SourceFile
+from .lockgraph import walk_excluding_nested_defs
+
+SCOPE = ("parallel/multihost.py",)
+BCAST_RE = re.compile(r"^self\._plane\.(send_\w+|_send)$")
+PAIR_RE = re.compile(r"^self\._engine\.\w+$")
+
+
+def _pos(node: ast.AST) -> tuple[int, int]:
+    return (node.lineno, node.col_offset)
+
+
+class PodBroadcastChecker(Checker):
+    name = "pod-broadcast"
+    description = (
+        "in RootControlEngine-style proxies, no raise/early-return between "
+        "a control-packet broadcast and its paired engine call; validation "
+        "precedes the broadcast"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        if not sf.endswith(*SCOPE):
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_method(sf, node.name, stmt)
+
+    def _check_method(self, sf: SourceFile, cls_name: str, fn):
+        events = []  # (pos, kind, node) in source order; nested defs are
+        # their own call stacks — a closure's return is not this method's
+        for node in walk_excluding_nested_defs(fn):
+            if isinstance(node, ast.Call):
+                spelled = ast.unparse(node.func)
+                if BCAST_RE.match(spelled):
+                    events.append((_pos(node), "bcast", node))
+                elif PAIR_RE.match(spelled):
+                    events.append((_pos(node), "pair", node))
+            elif isinstance(node, ast.Raise):
+                events.append((_pos(node), "raise", node))
+            elif isinstance(node, ast.Return):
+                kind = "pair" if self._contains_pair(node) else "return"
+                events.append((_pos(node), kind, node))
+        if not any(kind == "bcast" for _, kind, _ in events):
+            return
+        events.sort(key=lambda e: e[0])
+        open_bcast = None  # the broadcast awaiting its pair
+        for i, (_, kind, node) in enumerate(events):
+            if kind == "bcast":
+                open_bcast = node
+            elif kind == "pair":
+                open_bcast = None
+            elif open_bcast is not None:  # raise/return after a live send
+                pair_follows = any(k == "pair" for _, k, _ in events[i + 1:])
+                if kind == "return" and not pair_follows:
+                    # a pair-less op (OP_STOP, stats reset, flush) replays
+                    # no device program: returning after the send is its
+                    # normal shape, only a raise still desyncs
+                    continue
+                b = ast.unparse(open_bcast.func)
+                what = "raise" if kind == "raise" else "early return"
+                yield Finding(
+                    self.name, sf.display, node.lineno,
+                    f"{what} reachable after broadcast '{b}(...)' (line "
+                    f"{open_bcast.lineno}) in {cls_name}.{fn.name} before "
+                    "its paired engine call — workers enter the collective "
+                    "the root never dispatches and the pod deadlocks; "
+                    "validate BEFORE broadcasting",
+                )
+
+    @staticmethod
+    def _contains_pair(node: ast.Return) -> bool:
+        if node.value is None:
+            return False
+        return any(
+            isinstance(n, ast.Call) and PAIR_RE.match(ast.unparse(n.func))
+            for n in ast.walk(node.value)
+        )

@@ -12,6 +12,8 @@ import sys
 from pathlib import Path
 
 from .core import Analyzer, iter_py_files, load_baseline, write_baseline
+from .formats import render_github, render_sarif, render_text
+from .lockgraph import scan_paths
 from .registry import default_checkers
 
 PACKAGE_ROOT = Path(__file__).resolve().parent.parent  # the package dir
@@ -23,9 +25,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="dlint",
         description=(
-            "Project-invariant static analysis: lock discipline, host-sync "
-            "transfers, clock hygiene, condvar/thread hygiene, sharding "
-            "axis names. See docs/LINT.md."
+            "Project-invariant static analysis: cross-file lock-order "
+            "graph, blocking-under-lock, guarded-attr atomicity, "
+            "pod-broadcast pairing, lock discipline, host-sync transfers, "
+            "clock hygiene, condvar/thread hygiene, sharding axis names. "
+            "See docs/LINT.md."
         ),
     )
     ap.add_argument(
@@ -50,6 +54,19 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--list-checks", action="store_true", help="list checks and exit"
     )
+    ap.add_argument(
+        "--format", choices=("text", "github", "sarif"), default="text",
+        help="finding output format: plain file:line text (default), "
+        "GitHub Actions ::error annotations, or SARIF 2.1.0 JSON "
+        "(`make lint` picks github when GITHUB_ACTIONS=true)",
+    )
+    ap.add_argument(
+        "--graph", action="store_true",
+        help="dump the computed lock-order graph (DOT) and exit — nodes "
+        "are class-qualified locks, edges are 'held while acquiring' "
+        "sites, waived edges dashed; reviewers of new lock code eyeball "
+        "the new edges here",
+    )
     return ap
 
 
@@ -67,6 +84,11 @@ def main(argv=None) -> int:
             print(f"dlint: no such path: {p}", file=sys.stderr)
             return 2
     analyzer = Analyzer(checkers)
+    if args.graph:
+        model = scan_paths(paths, valid_checks=analyzer.valid_checks)
+        model.ensure_semantics()
+        print(model.dot())
+        return 0
     baseline = (
         set() if (args.no_baseline or args.write_baseline)
         else load_baseline(args.baseline)
@@ -89,11 +111,19 @@ def main(argv=None) -> int:
             )
             return 1
         return 0
-    for f in findings:
-        print(f.render())
+    if args.format == "github":
+        lines = render_github(findings)
+    elif args.format == "sarif":
+        lines = render_sarif(findings, checkers)
+    else:
+        lines = render_text(findings)
+    for line in lines:
+        print(line)
     n_files = len(iter_py_files(paths))
     if findings:
-        print(f"dlint: {len(findings)} finding(s) in {n_files} file(s)")
+        if args.format == "text":
+            print(f"dlint: {len(findings)} finding(s) in {n_files} file(s)")
         return 1
-    print(f"dlint: clean ({n_files} file(s))")
+    if args.format == "text":
+        print(f"dlint: clean ({n_files} file(s))")
     return 0

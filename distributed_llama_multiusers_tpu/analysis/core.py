@@ -5,8 +5,12 @@ no numpy: `make lint` must run anywhere CPython >= 3.10 runs, before any
 heavyweight import). Each checker gets two phases:
 
 - ``collect(sf, project)`` — gather cross-file facts (guarded-by
-  declarations, declared mesh axes) into the shared :class:`Project`;
-- ``check(sf, project)`` — yield :class:`Finding`s for one file.
+  declarations, declared mesh axes, the lock model) into the shared
+  :class:`Project`;
+- ``check(sf, project)`` — yield :class:`Finding`s for one file;
+- ``finalize(project)`` — yield findings that only exist once every file
+  has been seen (lock-order cycles span files, so no single ``check``
+  call can report them).
 
 Findings are suppressed by
 
@@ -88,6 +92,10 @@ class Project:
         # declared mesh axis names (from `AXES = (...)` in parallel/mesh.py)
         self.axes: set[str] = set()
         self.axes_src: str | None = None
+        # cross-file lock model (analysis/lockgraph.py), built by the
+        # lock-order checker's collect pass and shared by every
+        # concurrency check; None until that collect has run
+        self.lock_model = None
         # findings raised during collect (malformed declarations)
         self.collect_findings: list[Finding] = []
 
@@ -103,6 +111,9 @@ class Checker:
         return None
 
     def check(self, sf: SourceFile, project: Project) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
         return iter(())
 
 
@@ -312,6 +323,8 @@ class Analyzer:
             for sf in files:
                 for f in checker.check(sf, project):
                     findings.append(f)
+        for checker in self.checkers:
+            findings.extend(checker.finalize(project))
 
         out = []
         seen: set[tuple] = set()  # dedup (nested defs are walked twice)

@@ -1,0 +1,92 @@
+"""Finding renderers: the default ``file:line`` text plus CI formats.
+
+- ``github`` — GitHub Actions workflow commands (``::error file=...``):
+  every finding becomes an inline annotation on the PR diff. ``make
+  lint`` selects this automatically when ``GITHUB_ACTIONS=true``.
+- ``sarif`` — SARIF 2.1.0, the interchange format code-scanning UIs
+  ingest (one run, one rule per check, one result per finding).
+
+Pure stdlib (json), same as the rest of the analyzer.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from .core import Checker, Finding
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def render_text(findings: Iterable[Finding]) -> list[str]:
+    return [f.render() for f in findings]
+
+
+def _gh_escape(s: str) -> str:
+    """Workflow-command data escaping (the %, CR, LF triple GitHub
+    documents; properties additionally escape , and :)."""
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def render_github(findings: Iterable[Finding]) -> list[str]:
+    out = []
+    for f in findings:
+        path = _gh_escape(f.path).replace(",", "%2C").replace(":", "%3A")
+        out.append(
+            f"::error file={path},line={max(1, f.line)},"
+            f"title=dlint[{f.check}]::{_gh_escape(f.message)}"
+        )
+    return out
+
+
+def render_sarif(
+    findings: Iterable[Finding], checkers: Iterable[Checker]
+) -> list[str]:
+    rules = [
+        {
+            "id": c.name,
+            "shortDescription": {"text": c.description or c.name},
+        }
+        for c in checkers
+    ]
+    rules.append({
+        "id": "waiver",
+        "shortDescription": {
+            "text": "waiver syntax: reasons mandatory, names known"
+        },
+    })
+    rules.append({
+        "id": "parse",
+        "shortDescription": {"text": "file could not be analyzed"},
+    })
+    results = [
+        {
+            "ruleId": f.check,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dlint",
+                "informationUri": "docs/LINT.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+    return [json.dumps(doc, indent=2)]

@@ -1,0 +1,115 @@
+"""lock-atomicity: guarded read-modify-write must not straddle a release.
+
+``_dlint_guarded_by`` (lock_check.py) proves every touch of a guarded
+attribute happens under its lock — but lock-per-access is not atomicity.
+The classic residual bug is a read-modify-write split across two
+critical sections:
+
+    with q._lock:
+        depth = q._depth          # read
+    ...                            # <- lock released: anyone may write
+    with q._lock:
+        q._depth = depth - 1      # write of a stale value
+
+Each section is individually locked, so guarded-by is green, yet the
+interleaving loses updates (or acts on a stale check — the
+check-then-act variant ``if q._depth: ... with q._lock: q._depth -= 1``
+under two holds is the same shape). This check flags, within one
+function, a **pure read** of a guarded attribute under one hold of its
+lock followed by a **write** of the same base+attribute under a later,
+distinct hold of the same lock. ``x.attr += 1`` inside ONE section is
+fine (the AST spells it as a single Store; the implicit read never
+leaves the critical section) — only reads that survive a release count.
+
+Fix by folding the read and the write into one critical section (the
+QosQueue/EngineStats code already does: snapshots and bumps are
+single-hold by construction); waive (``ok[lock-atomicity] reason``) only
+for deliberately optimistic patterns that re-validate after reacquiring.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Finding, Project, SourceFile, nearest, walk_with_ancestors
+from .lockgraph import LockModel, module_stem, walk_excluding_nested_defs
+
+
+class LockAtomicityChecker(Checker):
+    name = "lock-atomicity"
+    description = (
+        "a guarded attribute read under one hold of its lock and written "
+        "under a later hold loses updates made between the two sections"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        model: LockModel = project.lock_model
+        if not project.guarded or model is None or not model.decls:
+            return
+        model.ensure_semantics()
+        stem = module_stem(sf.path)
+        for node, ancestors in walk_with_ancestors(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls = nearest(ancestors, ast.ClassDef)
+            class_ctx = cls.name if cls is not None else None
+            yield from self._check_fn(sf, node, project, model, class_ctx, stem)
+
+    def _check_fn(self, sf: SourceFile, fn, project, model, class_ctx, stem):
+        # every with-block in this function body that takes a known lock
+        # (nested defs excluded: they run on their own call stacks), in
+        # source order; each gets its guarded reads/writes attributed
+        blocks: list[dict] = []
+        own_nodes = set(map(id, walk_excluding_nested_defs(fn)))
+        for w in ast.walk(fn):
+            if id(w) not in own_nodes or not isinstance(w, (ast.With, ast.AsyncWith)):
+                continue
+            quals = set()
+            for item in w.items:
+                q = model.resolve(item.context_expr, class_ctx, stem)
+                if q is not None:
+                    quals.add(q)
+            if not quals:
+                continue
+            reads: dict[tuple[str, str], int] = {}
+            writes: dict[tuple[str, str], int] = {}
+            for inner in ast.walk(w):
+                if id(inner) not in own_nodes or not isinstance(inner, ast.Attribute):
+                    continue
+                if inner.attr not in project.guarded:
+                    continue
+                key = (ast.unparse(inner.value), inner.attr)
+                if isinstance(inner.ctx, ast.Load):
+                    reads.setdefault(key, inner.lineno)
+                else:  # Store (Assign / AugAssign target) or Del
+                    writes.setdefault(key, inner.lineno)
+            blocks.append({
+                "line": w.lineno, "quals": quals,
+                "reads": reads, "writes": writes,
+            })
+        blocks.sort(key=lambda b: b["line"])
+        reported: set[tuple] = set()
+        for i, early in enumerate(blocks):
+            for late in blocks[i + 1:]:
+                shared = early["quals"] & late["quals"]
+                if not shared:
+                    continue
+                for key, w_line in late["writes"].items():
+                    r_line = early["reads"].get(key)
+                    if r_line is None:
+                        continue
+                    base, attr = key
+                    mark = (base, attr, r_line, w_line)
+                    if mark in reported:
+                        continue
+                    reported.add(mark)
+                    lock = sorted(shared)[0]
+                    yield Finding(
+                        self.name, sf.display, w_line,
+                        f"read-modify-write of guarded '{base}.{attr}' "
+                        f"straddles a release of '{lock}': read at line "
+                        f"{r_line} and write at line {w_line} sit in "
+                        "separate critical sections — fold them into one "
+                        "hold, or waive an optimistic retry with "
+                        "'# dlint: ok[lock-atomicity] reason'",
+                    )

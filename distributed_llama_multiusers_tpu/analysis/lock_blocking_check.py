@@ -1,0 +1,104 @@
+"""lock-blocking: no blocking construct while holding a known lock.
+
+The package's locks guard short critical sections — counter bumps, queue
+surgery, ring appends. Holding one across anything that can block turns
+every other thread touching that lock into a convoy behind the slow
+operation (and, for the pod control plane, into a distributed deadlock:
+a broadcast under a lock serializes every process on one host's lock
+hold). This check mechanizes two rules that previously lived in
+comments:
+
+- the PR 5 **wait-observer rule** — ``QosQueue.set_wait_observer``
+  callbacks run OUTSIDE the queue lock (an observer/hook call under a
+  known lock is a finding);
+- the multihost **"never broadcast under a lock"** rule —
+  ``broadcast_one_to_all`` / ``ControlPlane.send_*`` under any lock is a
+  finding.
+
+The blocking vocabulary (lockgraph.iter_blocking) extends the host-sync
+pattern set: device->host transfers, socket/stream I/O (``sendall`` /
+``recv`` / ``urlopen`` / ``print``), ``future.result()``, thread
+``join``, ``time.sleep``, subprocess execution, collective/packet sends,
+and observer/hook invocations. ``Condition.wait`` is judged in context:
+waiting on the condition built over the lock you hold is the one
+legitimate blocking-under-lock (that IS how condvars work — the wait
+releases it); waiting on anything else while a lock is held parks the
+thread with the lock still taken.
+
+One level of intra-package calls is expanded: calling a function that
+directly contains a blocking construct while holding a lock is flagged
+at the call site. Sanctioned sites (the native build serialized behind
+``native._lock``, the JSON logger's line write under ``_log_lock``)
+carry ``# dlint: ok[lock-blocking] reason`` waivers naming why the hold
+is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Finding, Project, SourceFile, nearest, walk_with_ancestors
+from .lockgraph import LockModel, classify_blocking_call, module_stem
+
+
+class LockBlockingChecker(Checker):
+    name = "lock-blocking"
+    description = (
+        "blocking constructs (I/O, waits, sends, broadcasts, observer "
+        "calls, subprocesses) while holding a declared lock convoy every "
+        "other thread on that lock"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        model: LockModel = project.lock_model
+        if model is None or not model.decls:
+            return
+        model.ensure_semantics()
+        stem = module_stem(sf.path)
+        for node, ancestors in walk_with_ancestors(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls = nearest(ancestors, ast.ClassDef)
+            class_ctx = cls.name if cls is not None else None
+            held = model.held_at(ancestors, class_ctx, stem)
+            if not held:
+                continue
+            held_names = ", ".join(sorted({q for q, _ in held}))
+            entry = classify_blocking_call(node)
+            if entry is not None:
+                kind, descr = entry
+                if kind == "wait" and self._own_lock_wait(
+                    node, held, model, class_ctx, stem
+                ):
+                    continue
+                yield Finding(
+                    self.name, sf.display, node.lineno,
+                    f"{descr} while holding '{held_names}' blocks every "
+                    "thread contending on that lock; move it outside the "
+                    "critical section or waive with "
+                    "'# dlint: ok[lock-blocking] <why the hold is the point>'",
+                )
+                continue
+            # one level of intra-package calls: a callee that directly
+            # blocks, invoked with the lock held, holds it just the same
+            info = model._resolve_callee(node, sf, class_ctx)
+            if info is not None and info.blocking:
+                line, descr = info.blocking[0]
+                yield Finding(
+                    self.name, sf.display, node.lineno,
+                    f"call to '{ast.unparse(node.func)}(...)' while holding "
+                    f"'{held_names}' — the callee blocks ({descr} at "
+                    f"line {line}); hoist the call out of the critical "
+                    "section or waive with '# dlint: ok[lock-blocking] <why>'",
+                )
+
+    @staticmethod
+    def _own_lock_wait(node: ast.Call, held, model: LockModel,
+                       class_ctx, stem) -> bool:
+        """``cv.wait()`` where cv aliases a held lock releases that lock
+        for the duration — the legitimate condvar shape."""
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return False
+        qual = model.resolve(func.value, class_ctx, stem)
+        return qual is not None and qual in {q for q, _ in held}

@@ -1,0 +1,71 @@
+"""lock-order: the cross-file lock-order graph must stay acyclic.
+
+The collect pass builds the package's lock model (analysis/lockgraph.py):
+every class-qualified lock declaration (``QosQueue._lock``,
+``EngineStats.lock``, ``SpanTracer._trace_lock``, ...), every Condition
+alias, and — in finalize, once all files have been seen — every "A held
+while acquiring B" edge, including one level of intra-package calls (a
+``with self._lock:`` body calling a method that takes another known
+lock). Any cycle in that graph is a potential deadlock the test suite
+will only reproduce under exactly the wrong interleaving, so it is a
+lint finding instead:
+
+- a two-or-more-lock cycle means two threads can each hold one lock and
+  wait for the other;
+- a self-edge means re-acquiring a non-reentrant lock — a deadlock with
+  no second thread required.
+
+Intentional nesting is waived at the inner acquisition site
+(``# dlint: ok[lock-order] reason``); waived edges are dropped from the
+cycle check but still drawn (dashed) by ``dlint --graph``, and excluded
+from the runtime witness's static seed so lockcheck honors the waiver.
+
+The same statically computed order seeds the runtime witness
+(``DLLAMA_LOCKCHECK=1``, lockcheck.py): the graph reviewed here is the
+order the witness enforces on the real scheduler/QoS/telemetry paths.
+"""
+
+from __future__ import annotations
+
+from .core import Checker, Finding, Project, SourceFile
+from .lockgraph import LockModel
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+    description = (
+        "the cross-file 'held while acquiring' graph over declared locks "
+        "must be acyclic (one level of intra-package calls included)"
+    )
+
+    def collect(self, sf: SourceFile, project: Project) -> None:
+        if project.lock_model is None:
+            project.lock_model = LockModel()
+        project.lock_model.add_file(sf)
+
+    def finalize(self, project: Project):
+        model: LockModel = project.lock_model
+        if model is None:
+            return
+        yield from model.findings  # declaration findings (witness-name drift)
+        for cycle in model.cycles():
+            first = cycle[0]
+            if len(cycle) == 1 and first.a == first.b:
+                via = f" via {first.via}()" if first.via else ""
+                yield Finding(
+                    self.name, first.path, first.line,
+                    f"re-acquisition of non-reentrant lock '{first.a}'"
+                    f"{via} — deadlocks with no second thread involved",
+                )
+                continue
+            hops = " -> ".join(
+                f"{e.b} ({e.site}{f' via {e.via}()' if e.via else ''})"
+                for e in cycle
+            )
+            yield Finding(
+                self.name, first.path, first.line,
+                f"lock-order cycle: {cycle[0].a} -> {hops} — two threads "
+                "taking these locks in opposite orders deadlock; pick one "
+                "order (or waive the intentional edge with "
+                "'# dlint: ok[lock-order] reason')",
+            )

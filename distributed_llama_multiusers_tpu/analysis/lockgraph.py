@@ -1,0 +1,630 @@
+"""Cross-file lock model: the shared substrate of the concurrency checks.
+
+The four dlint v2 concurrency checks (``lock-order``, ``lock-blocking``,
+``lock-atomicity``, ``pod-broadcast``) all need the same facts: which
+attributes in the package ARE locks, which class owns each one, which
+Condition is just a view of which lock, and which locks each
+function/method acquires. This module collects them once per analyzer run
+into a :class:`LockModel` stored on the shared ``Project``.
+
+Lock identity is **class-qualified**: the node for ``self._lock`` inside
+``QosQueue.__init__`` is ``"QosQueue._lock"``, so the three ``_m_lock``
+instances in ``telemetry/metrics.py`` are three distinct nodes. Module-
+level locks qualify by module stem (``native/__init__.py``'s ``_lock`` is
+``"native._lock"``). These names are also the runtime witness's vocabulary
+(``lockcheck.make_lock("QosQueue._lock")``): the collect pass recognizes
+``make_lock`` declaration sites, reads the literal, and reports a
+mismatch between the literal and the class-qualified attribute as a
+finding — the static graph and the runtime witness cannot drift apart
+silently.
+
+A ``threading.Condition(self._lock)`` built over a known lock is an
+**alias**: entering the condition IS entering the lock, so acquisitions
+through either spelling resolve to one canonical node (exactly the
+``("_lock", "_not_empty")`` equivalence the guarded-by declarations
+already encode).
+
+The **lock-order graph** has an edge A→B for every "A held while
+acquiring B" site, including ONE level of intra-package calls: a
+``with self._lock:`` body calling a method that itself takes a known
+lock contributes an edge through that call. Edges carry their site and a
+``waived`` flag (``# dlint: ok[lock-order] reason`` on the acquisition
+line) so intentional nesting is both suppressed and documented in place.
+
+Resolution is name-based like the rest of dlint (no type inference):
+an attribute access resolves to the declaring class when the access sits
+inside that class, then to a same-module declaration, then to a unique
+project-wide declaration; ambiguous names resolve to nothing. Keep lock
+attribute names distinctive — the shipped ones are.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core import Finding, SourceFile, nearest, parse_waivers, walk_with_ancestors
+
+LOCK_CTOR_NAMES = {"Lock", "RLock"}
+COND_CTOR_NAMES = {"Condition"}
+MAKE_LOCK_NAME = "make_lock"
+
+# built-in fallback so standalone scans (CLI --graph, the runtime witness
+# seed) can parse waivers without importing the registry (which imports
+# the checkers, which import this module)
+_FALLBACK_VALID_CHECKS = {
+    "guarded-by", "host-sync", "pipeline-sync", "clock", "condvar",
+    "sharding-axis", "lock-order", "lock-blocking", "lock-atomicity",
+    "pod-broadcast",
+}
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    """One declared lock (or condition alias) in the analyzed set."""
+
+    qual: str  # class-qualified id, e.g. "QosQueue._lock"
+    attr: str  # the attribute/name use sites spell, e.g. "_lock"
+    owner: str  # class name, or module stem for module-level locks
+    path: str  # display path of the declaring file
+    line: int
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+@dataclass(frozen=True)
+class Edge:
+    """One 'a held while acquiring b' site in the lock-order graph."""
+
+    a: str
+    b: str
+    path: str
+    line: int
+    via: str | None  # callee name for one-level call edges, None for direct
+    waived: bool
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+@dataclass
+class FuncInfo:
+    """Per-function facts for the one-level call expansion."""
+
+    key: tuple[str, str, str]  # (module display, owner class or "", name)
+    acquires: set[str] = field(default_factory=set)  # direct, canonical
+    blocking: list[tuple[int, str]] = field(default_factory=list)
+
+
+def module_stem(path: Path) -> str:
+    """Module-level locks qualify by the module's import name component:
+    ``native/__init__.py`` -> ``native``, ``telemetry/logs.py`` -> ``logs``."""
+    if path.stem == "__init__":
+        return path.parent.name
+    return path.stem
+
+
+def _call_name(value: ast.AST) -> tuple[str, ast.Call] | None:
+    """(final callee component, call node) for a Call expression, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    func = value.func
+    if isinstance(func, ast.Attribute):
+        return func.attr, value
+    if isinstance(func, ast.Name):
+        return func.id, value
+    return None
+
+
+def _unwrap_factory(value: ast.AST) -> ast.AST:
+    """``field(default_factory=X)`` declares whatever X builds; a lambda
+    factory declares its body. Anything else passes through unchanged."""
+    named = _call_name(value)
+    if named is not None and named[0] == "field":
+        for kw in named[1].keywords:
+            if kw.arg == "default_factory":
+                value = kw.value
+                break
+    if isinstance(value, ast.Lambda):
+        return value.body
+    return value
+
+
+def classify_ctor(value: ast.AST):
+    """Classify a declaration RHS: ``("lock", None)`` for Lock/RLock
+    constructions, ``("cond", arg)`` for Condition(arg) (arg may be None:
+    a bare Condition owns its own lock), ``("named", literal)`` for
+    ``make_lock("Owner.attr")`` witness-wrapped declarations, else None."""
+    value = _unwrap_factory(value)
+    named = _call_name(value)
+    if named is None:
+        # bare `threading.Lock` (no call) as a default_factory
+        if isinstance(value, ast.Attribute) and value.attr in LOCK_CTOR_NAMES:
+            return ("lock", None)
+        if isinstance(value, ast.Name) and value.id in LOCK_CTOR_NAMES:
+            return ("lock", None)
+        return None
+    name, call = named
+    if name in LOCK_CTOR_NAMES:
+        return ("lock", None)
+    if name in COND_CTOR_NAMES:
+        return ("cond", call.args[0] if call.args else None)
+    if name == MAKE_LOCK_NAME:
+        if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+            call.args[0].value, str
+        ):
+            return ("named", call.args[0].value)
+        return ("named", None)  # malformed: non-literal witness name
+    return None
+
+
+def _decl_targets(node: ast.AST) -> list[tuple[str, ast.AST]]:
+    """(attr-or-name, value) pairs a statement declares. ``self.X = ...``
+    yields X; plain ``X = ...`` yields X (class body or module level)."""
+    if isinstance(node, ast.Assign):
+        targets, value = node.targets, node.value
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        targets, value = [node.target], node.value
+    else:
+        return []
+    out = []
+    for tgt in targets:
+        if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id == "self":
+            out.append((tgt.attr, value))
+        elif isinstance(tgt, ast.Name):
+            out.append((tgt.id, value))
+    return out
+
+
+# -- blocking-construct vocabulary (shared with lock_blocking_check) ---------
+
+# names shared with builtin containers/strings never resolve through the
+# unique-project-wide fallback: `self._reg_metrics.get(...)` is dict.get,
+# not MetricsRegistry.get, and name-based matching cannot tell — so it
+# declines (self-calls and bare module calls stay precise)
+AMBIENT_METHOD_NAMES = frozenset(
+    dir(dict)) | frozenset(dir(list)) | frozenset(dir(set)) \
+    | frozenset(dir(str)) | frozenset(dir(tuple)) | frozenset(dir(bytes))
+
+SYNC_METHODS = {"item", "tolist", "block_until_ready", "all_logits",
+                "lane_logits", "device_get"}
+SYNC_FUNCS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+              "jax.device_get"}
+SOCKET_METHODS = {"sendall", "recv", "accept", "connect"}
+SUBPROCESS_FUNCS = {"subprocess.run", "subprocess.check_call",
+                    "subprocess.check_output", "subprocess.Popen"}
+BROADCAST_NAMES = {"broadcast_one_to_all", "_bcast"}
+
+
+def classify_blocking_call(node: ast.Call) -> tuple[str, str] | None:
+    """``(kind, description)`` when the call is a blocking construct, else
+    None. Kinds: ``"wait"`` needs held-lock context to judge (a
+    Condition.wait on the HELD lock is the one legitimate
+    blocking-under-lock); everything else blocks unconditionally."""
+    func = node.func
+    last = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None
+    )
+    if last is None:
+        return None
+    spelled = ast.unparse(func)
+    if last in SYNC_METHODS or spelled in SYNC_FUNCS:
+        return "sync", f"device->host sync '{spelled}(...)'"
+    if last in ("wait", "wait_for") and isinstance(func, ast.Attribute):
+        return "wait", f"'{spelled}(...)'"
+    if last == "result" and isinstance(func, ast.Attribute):
+        return "future", f"future '{spelled}(...)'"
+    if last in SOCKET_METHODS and isinstance(func, ast.Attribute):
+        return "io", f"socket/stream '{spelled}(...)'"
+    if last == "urlopen":
+        return "io", f"HTTP '{spelled}(...)'"
+    if last == "print" and isinstance(func, ast.Name):
+        return "io", "stream write 'print(...)'"
+    if last == "sleep":
+        return "sleep", f"'{spelled}(...)'"
+    if spelled in SUBPROCESS_FUNCS:
+        return "subprocess", f"subprocess '{spelled}(...)'"
+    if last in BROADCAST_NAMES or (
+        last.startswith("send_") and isinstance(func, ast.Attribute)
+    ):
+        return "broadcast", f"collective/packet send '{spelled}(...)'"
+    if last == "join" and isinstance(func, ast.Attribute) and not (
+        # str.join / os.path.join, same carve-out as the condvar check
+        isinstance(func.value, ast.Constant)
+        or ast.unparse(func.value).endswith("path")
+    ):
+        return "join", f"thread join '{spelled}(...)'"
+    if _observer_name(last):
+        return "observer", f"observer/hook call '{spelled}(...)'"
+    return None
+
+
+def _observer_name(name: str) -> bool:
+    """The documented observer/hook vocabulary: ``on_*`` (underscore
+    prefixes stripped) plus ``*observer*``/``*callback*``/``*hook*`` —
+    for Name and Attribute callees alike, so renaming ``_on_pop_wait``
+    to ``_wait_observer`` cannot silently retire the rule."""
+    return (
+        name.lstrip("_").startswith("on_")
+        or "observer" in name
+        or "callback" in name
+        or "hook" in name
+    )
+
+
+def walk_excluding_nested_defs(root: ast.AST):
+    """``ast.walk`` over ``root`` skipping the bodies of nested
+    functions/lambdas — they run on their own call stacks, so lexical
+    facts about ``root`` (held locks, reachable raises/returns, blocking
+    constructs) do not apply to them. Shared by every check that scopes
+    to one function."""
+    skip: set[int] = set()
+    for d in ast.walk(root):
+        if isinstance(
+            d, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ) and d is not root:
+            for inner in ast.walk(d):
+                skip.add(id(inner))
+    for node in ast.walk(root):
+        if id(node) not in skip:
+            yield node
+
+
+def iter_blocking(root: ast.AST):
+    """Yield ``(call_node, kind, description)`` for every blocking
+    construct directly inside ``root`` (nested defs excluded)."""
+    for node in walk_excluding_nested_defs(root):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = classify_blocking_call(node)
+        if hit is not None:
+            yield node, hit[0], hit[1]
+
+
+# -- the model ----------------------------------------------------------------
+
+
+class LockModel:
+    def __init__(self):
+        self.decls: dict[str, LockDecl] = {}
+        self.by_attr: dict[str, set[str]] = {}
+        self.alias: dict[str, str] = {}  # condition qual -> lock qual
+        self._alias_pending: list[tuple[str, str, str]] = []  # qual, owner, target attr
+        self.funcs: dict[tuple[str, str, str], FuncInfo] = {}
+        self.methods_by_name: dict[str, list[tuple[str, str, str]]] = {}
+        self.edges: list[Edge] = []
+        self.findings: list[Finding] = []
+        self._files: list[SourceFile] = []
+        self._resolved = False
+        self._edges_built = False
+
+    # -- phase 1: per-file declaration scan (Analyzer collect) ---------------
+
+    def add_file(self, sf: SourceFile) -> None:
+        self._files.append(sf)
+        stem = module_stem(sf.path)
+        for node, ancestors in walk_with_ancestors(sf.tree):
+            pairs = _decl_targets(node)
+            if not pairs:
+                continue
+            cls = nearest(ancestors, ast.ClassDef)
+            owner = cls.name if cls is not None else stem
+            if cls is None and nearest(
+                ancestors, ast.FunctionDef, ast.AsyncFunctionDef
+            ) is not None:
+                continue  # lock local to a function: not shared state
+            for attr, value in pairs:
+                kind = classify_ctor(value)
+                if kind is None:
+                    continue
+                qual = f"{owner}.{attr}"
+                if kind[0] == "named":
+                    literal = kind[1]
+                    if literal is None:
+                        self.findings.append(Finding(
+                            "lock-order", sf.display, node.lineno,
+                            f"make_lock declaration for '{qual}' needs a "
+                            "string-literal witness name",
+                        ))
+                    elif literal != qual:
+                        self.findings.append(Finding(
+                            "lock-order", sf.display, node.lineno,
+                            f"witness lock name {literal!r} does not match its "
+                            f"class-qualified declaration '{qual}' — the "
+                            "runtime witness and the static graph would track "
+                            "different locks",
+                        ))
+                    self._declare(qual, attr, owner, sf, node.lineno)
+                elif kind[0] == "lock":
+                    self._declare(qual, attr, owner, sf, node.lineno)
+                elif kind[0] == "cond":
+                    arg = kind[1]
+                    target = None
+                    if isinstance(arg, ast.Attribute) and isinstance(
+                        arg.value, ast.Name
+                    ) and arg.value.id == "self":
+                        target = arg.attr
+                    elif isinstance(arg, ast.Name):
+                        target = arg.id
+                    self._declare(qual, attr, owner, sf, node.lineno)
+                    if target is not None:
+                        # resolve once every declaration has been seen
+                        self._alias_pending.append((qual, owner, target))
+
+    def _declare(self, qual, attr, owner, sf: SourceFile, line: int) -> None:
+        if qual not in self.decls:
+            self.decls[qual] = LockDecl(qual, attr, owner, sf.display, line)
+            self.by_attr.setdefault(attr, set()).add(qual)
+
+    # -- phase 2: cross-file resolution (idempotent; any check may call) -----
+
+    def ensure_semantics(self) -> None:
+        if self._resolved:
+            return
+        self._resolved = True
+        for qual, owner, target in self._alias_pending:
+            target_qual = f"{owner}.{target}"
+            if target_qual in self.decls:
+                self.alias[qual] = target_qual
+        for sf in self._files:
+            stem = module_stem(sf.path)
+            for node, ancestors in walk_with_ancestors(sf.tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                cls = nearest(ancestors, ast.ClassDef)
+                owner = cls.name if cls is not None else ""
+                key = (sf.display, owner, node.name)
+                info = self.funcs.setdefault(key, FuncInfo(key))
+                self.methods_by_name.setdefault(node.name, []).append(key)
+                for inner, inner_anc in walk_with_ancestors(node):
+                    if isinstance(inner, (ast.With, ast.AsyncWith)):
+                        for item in inner.items:
+                            qual = self.resolve(
+                                item.context_expr, cls.name if cls else None,
+                                stem,
+                            )
+                            if qual is not None:
+                                info.acquires.add(qual)
+                for call, kind, descr in iter_blocking(node):
+                    if kind != "wait":  # wait needs held-set context
+                        info.blocking.append((call.lineno, descr))
+
+    def canonical(self, qual: str) -> str:
+        seen = set()
+        while qual in self.alias and qual not in seen:
+            seen.add(qual)
+            qual = self.alias[qual]
+        return qual
+
+    def resolve(self, expr: ast.AST, class_ctx: str | None,
+                stem: str) -> str | None:
+        """Canonical lock qual for an acquisition expression (``self._lock``,
+        ``self.engine.stats.lock``, module-level ``_lock``), or None."""
+        if isinstance(expr, ast.Call):  # e.g. `with self._get_lock():` — opaque
+            return None
+        if isinstance(expr, ast.Name):
+            # a bare name only ever denotes a module-level lock of THIS
+            # module; a function-local `lock = threading.Lock()` (skipped
+            # at declaration time) must not fall through to the unique
+            # fallback and mis-bind to an unrelated class's lock
+            if f"{stem}.{expr.id}" in self.by_attr.get(expr.id, ()):
+                return self.canonical(f"{stem}.{expr.id}")
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        candidates = self.by_attr.get(attr)
+        if not candidates:
+            return None
+        if class_ctx is not None and f"{class_ctx}.{attr}" in candidates:
+            return self.canonical(f"{class_ctx}.{attr}")
+        if f"{stem}.{attr}" in candidates:
+            return self.canonical(f"{stem}.{attr}")
+        if len(candidates) == 1:
+            return self.canonical(next(iter(candidates)))
+        return None  # ambiguous: name-based matching declines to guess
+
+    def held_at(self, ancestors, class_ctx: str | None,
+                stem: str) -> list[tuple[str, int]]:
+        """(canonical qual, with-line) for every known lock held at a node,
+        innermost first, stopping at the first def/lambda boundary (a
+        closure body runs after the enclosing with released its lock)."""
+        held: list[tuple[str, int]] = []
+        for a in reversed(list(ancestors)):
+            if isinstance(a, (ast.With, ast.AsyncWith)):
+                for item in a.items:
+                    qual = self.resolve(item.context_expr, class_ctx, stem)
+                    if qual is not None:
+                        held.append((qual, a.lineno))
+            elif isinstance(
+                a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                break
+        return held
+
+    # -- phase 3: the lock-order graph (lock-order finalize) -----------------
+
+    def _edge_waived(self, sf: SourceFile, line: int) -> bool:
+        w = sf.waivers.get(line)
+        if w is not None and w.covers("lock-order"):
+            return True
+        prev = sf.waivers.get(line - 1)
+        return prev is not None and prev.standalone and prev.covers("lock-order")
+
+    def build_edges(self) -> None:
+        if self._edges_built:
+            return
+        self._edges_built = True
+        self.ensure_semantics()
+        for sf in self._files:
+            stem = module_stem(sf.path)
+            for node, ancestors in walk_with_ancestors(sf.tree):
+                cls = nearest(ancestors, ast.ClassDef)
+                class_ctx = cls.name if cls is not None else None
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    quals = [
+                        q for item in node.items
+                        if (q := self.resolve(item.context_expr, class_ctx, stem))
+                        is not None
+                    ]
+                    if not quals:
+                        continue
+                    held = self.held_at(ancestors, class_ctx, stem)
+                    waived = self._edge_waived(sf, node.lineno)
+                    for i, b in enumerate(quals):
+                        for a, _ in held:
+                            self.edges.append(Edge(
+                                a, b, sf.display, node.lineno, None, waived
+                            ))
+                        # `with a, b:` acquires left-to-right: ordered too
+                        for a in quals[:i]:
+                            self.edges.append(Edge(
+                                a, b, sf.display, node.lineno, None, waived
+                            ))
+                elif isinstance(node, ast.Call):
+                    held = self.held_at(ancestors, class_ctx, stem)
+                    if not held:
+                        continue
+                    info = self._resolve_callee(node, sf, class_ctx)
+                    if info is None or not info.acquires:
+                        continue
+                    waived = self._edge_waived(sf, node.lineno)
+                    callee = ast.unparse(node.func)
+                    for b in info.acquires:
+                        for a, _ in held:
+                            self.edges.append(Edge(
+                                a, b, sf.display, node.lineno, callee, waived
+                            ))
+
+    def _resolve_callee(self, node: ast.Call, sf: SourceFile,
+                        class_ctx: str | None) -> FuncInfo | None:
+        """One level of intra-package call resolution, name-based: `self.m()`
+        binds in the enclosing class, bare `f()` in the module, `x.m()`
+        only when the method name is unique project-wide."""
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                if class_ctx is not None:
+                    return self.funcs.get((sf.display, class_ctx, name))
+                return None
+            if name in AMBIENT_METHOD_NAMES:
+                return None  # dict.get/list.pop/... masquerade as methods
+            keys = self.methods_by_name.get(name, [])
+            if len(keys) == 1:
+                return self.funcs.get(keys[0])
+            return None
+        if isinstance(func, ast.Name):
+            return self.funcs.get((sf.display, "", func.id))
+        return None
+
+    def order_edges(self, include_waived: bool = False) -> list[Edge]:
+        self.build_edges()
+        out = [e for e in self.edges if include_waived or not e.waived]
+        # one representative per (a, b): deterministic, earliest site
+        best: dict[tuple[str, str], Edge] = {}
+        for e in sorted(out, key=lambda e: (e.a, e.b, e.path, e.line)):
+            best.setdefault((e.a, e.b), e)
+        return list(best.values())
+
+    def cycles(self) -> list[list[Edge]]:
+        """Cycles in the non-waived order graph, each as its edge list
+        (self-edges are length-1 cycles: re-acquiring a non-reentrant
+        lock deadlocks without any second lock involved)."""
+        edges = self.order_edges()
+        adj: dict[str, list[Edge]] = {}
+        for e in edges:
+            adj.setdefault(e.a, []).append(e)
+        out: list[list[Edge]] = []
+        for e in edges:
+            if e.a == e.b:
+                out.append([e])
+        # DFS from each node, smallest-first for determinism; report a
+        # cycle only when it closes on the root so each cycle is found
+        # exactly once (at its lexicographically smallest node)
+        for root in sorted(adj):
+            stack: list[tuple[str, list[Edge]]] = [(root, [])]
+            seen_paths = set()
+            while stack:
+                node, path = stack.pop()
+                for e in sorted(
+                    adj.get(node, []), key=lambda e: e.b, reverse=True
+                ):
+                    if e.a == e.b:
+                        continue
+                    if e.b == root:
+                        key = tuple(x.b for x in path) + (e.b,)
+                        if key not in seen_paths:
+                            seen_paths.add(key)
+                            out.append(path + [e])
+                    elif e.b > root and all(p.b != e.b for p in path):
+                        stack.append((e.b, path + [e]))
+        return out
+
+    def dot(self) -> str:
+        """The computed lock-order graph in DOT, for reviewer eyeballs
+        (``dlint --graph``). Waived edges render dashed: intentional
+        nesting stays visible without tripping the cycle check."""
+        self.build_edges()
+        lines = ["digraph dlint_lock_order {"]
+        lines.append('  rankdir=LR; node [shape=box, fontname="monospace"];')
+        for qual in sorted(self.decls):
+            canon = self.canonical(qual)
+            if canon != qual:
+                continue  # aliases collapse into their canonical lock
+            aliases = sorted(
+                q for q, target in self.alias.items() if target == qual
+            )
+            label = qual if not aliases else f"{qual}\\n(= {', '.join(aliases)})"
+            lines.append(f'  "{qual}" [label="{label}"];')
+        for e in self.order_edges(include_waived=True):
+            style = ', style=dashed' if e.waived else ""
+            lines.append(
+                f'  "{e.a}" -> "{e.b}" [label="{e.site}"{style}];'
+            )
+        lines.append("}")
+        return "\n".join(lines)
+
+
+# -- standalone entry points (CLI --graph, runtime witness seed) -------------
+
+
+def scan_paths(paths, valid_checks: set[str] | None = None) -> LockModel:
+    """Build a LockModel outside an Analyzer run: parse ``paths`` (files or
+    directories), scan declarations, and leave the model ready for
+    ``order_edges()``/``dot()``. Parse failures are skipped — the full
+    analyzer reports those."""
+    from .core import iter_py_files
+
+    if valid_checks is None:
+        valid_checks = set(_FALLBACK_VALID_CHECKS)
+    model = LockModel()
+    for p in iter_py_files(paths):
+        try:
+            text = p.read_text(encoding="utf-8")
+            tree = ast.parse(text, filename=str(p))
+        except (OSError, SyntaxError, ValueError):
+            continue
+        sf = SourceFile(
+            path=p, display=p.as_posix(), text=text, tree=tree
+        )
+        sf.waivers, _ = parse_waivers(text, valid_checks, sf.display)
+        model.add_file(sf)
+    return model
+
+
+def package_lock_graph(include_waived: bool = False):
+    """(a, b, site) tuples of the package's statically computed lock-order
+    edges — the runtime witness's seed. Waived edges are excluded by
+    default: a waiver documents intentional nesting, and the witness must
+    not fire on the order the waiver just sanctioned."""
+    package_root = Path(__file__).resolve().parent.parent
+    model = scan_paths([package_root])
+    return [
+        (e.a, e.b, e.site) for e in model.order_edges(include_waived)
+    ]

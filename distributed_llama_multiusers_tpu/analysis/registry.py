@@ -1,17 +1,33 @@
-"""Checker registry: the six project-invariant checks, in report order."""
+"""Checker registry: the ten project-invariant checks, in report order.
+
+Order matters for collection: the lock-order checker's collect pass
+builds the shared cross-file lock model (``project.lock_model``) that
+the other concurrency checks read. The analyzer runs every checker's
+collect over every file before any check runs, so the model is complete
+regardless of this ordering — but keeping the graph builder first keeps
+the dependency legible.
+"""
 
 from __future__ import annotations
 
+from .broadcast_check import PodBroadcastChecker
 from .clock_check import ClockChecker
 from .condvar_check import CondvarChecker
 from .core import Checker
 from .host_sync_check import HostSyncChecker
+from .lock_atomicity_check import LockAtomicityChecker
+from .lock_blocking_check import LockBlockingChecker
 from .lock_check import GuardedByChecker
+from .lock_order_check import LockOrderChecker
 from .pipeline_check import PipelineSyncChecker
 from .sharding_check import ShardingAxisChecker
 
 ALL_CHECKERS = (
+    LockOrderChecker,
     GuardedByChecker,
+    LockBlockingChecker,
+    LockAtomicityChecker,
+    PodBroadcastChecker,
     HostSyncChecker,
     PipelineSyncChecker,
     ClockChecker,

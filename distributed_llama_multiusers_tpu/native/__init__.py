@@ -12,15 +12,18 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from ..lockcheck import make_lock
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO_PATH = os.path.join(_DIR, "libdllama_native.so")
 _SRC = os.path.join(_DIR, "quant_codec.cpp")
 
-_lock = threading.Lock()
+# witness-wrappable (DLLAMA_LOCKCHECK=1, lockcheck.py); module-level locks
+# qualify by module stem in the static lock graph
+_lock = make_lock("native._lock")
 _lib: ctypes.CDLL | None = None
 _load_failed = False
 
@@ -76,6 +79,7 @@ def load() -> ctypes.CDLL | None:
             return None
         # test hook: point at an alternate build (e.g. the sanitized .so)
         override = os.environ.get("DLLAMA_NATIVE_SO")
+        # dlint: ok[lock-blocking] first-load compile is serialized behind the load lock on purpose: concurrent importers must block until one .so exists rather than race the compiler
         if not override and not ensure_built():
             _load_failed = True
             return None

@@ -17,6 +17,17 @@ Reference mapping (src/app.cpp):
 - worker mode's control-packet poll loop (src/app.cpp:218-231) ->
   ``worker_loop``: recv packet, replay the identical engine call so every
   process dispatches the same XLA program in lockstep.
+
+Pod-deadlock rule — MACHINE-CHECKED by dlint's ``pod-broadcast`` check
+(analysis/broadcast_check.py, scoped to this file): in every
+``RootControlEngine`` proxy method, argument validation runs BEFORE the
+packet broadcast, and no ``raise`` or early ``return`` is reachable
+between a ``self._plane.send_*`` broadcast and its paired
+``self._engine`` call. A packet with no matching root-side compute
+leaves every worker blocked inside a collective the root never
+dispatches — a hang with no timeout, invisible until the pod is dead.
+Relatedly, dlint's ``lock-blocking`` check forbids broadcasting while
+holding any declared lock anywhere in the package. See docs/LINT.md.
 """
 
 from __future__ import annotations

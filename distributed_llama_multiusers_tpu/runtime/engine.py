@@ -30,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..lockcheck import make_lock
 from ..models.config import LlamaConfig
 from ..models.llama import KVCache, LlamaParams, init_kv_cache, llama_forward
 from ..telemetry.logs import log_event
@@ -101,7 +102,10 @@ class EngineStats:
     # /stats read sees one consistent point in time instead of field-by-field
     # values racing the batching thread
     lock: threading.Lock = field(
-        default_factory=threading.Lock, repr=False, compare=False
+        # built via make_lock so the runtime lock-order witness
+        # (DLLAMA_LOCKCHECK=1) can wrap it; literal cross-checked by dlint
+        default_factory=lambda: make_lock("EngineStats.lock"),
+        repr=False, compare=False,
     )
 
     # dlint guarded-by declaration (analysis/lock_check.py): every counter

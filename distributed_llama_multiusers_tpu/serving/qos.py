@@ -38,6 +38,8 @@ from collections import OrderedDict, deque
 from enum import IntEnum
 from typing import Callable
 
+from ..lockcheck import make_lock
+
 
 class Priority(IntEnum):
     """Strict admission classes; lower value pops first."""
@@ -134,7 +136,11 @@ class QosQueue:
         self.capacity = max(0, int(capacity))
         self.quantum = float(quantum)
         self._cost = cost or _default_cost
-        self._lock = threading.Lock()
+        # built via make_lock so the runtime lock-order witness
+        # (DLLAMA_LOCKCHECK=1, lockcheck.py) can wrap it; the literal must
+        # match the class-qualified name — dlint's lock-order collect
+        # cross-checks it
+        self._lock = make_lock("QosQueue._lock")
         self._not_empty = threading.Condition(self._lock)
         # priority -> (user_id -> FIFO of that user's requests); the
         # OrderedDict order IS the DRR rotation for that class

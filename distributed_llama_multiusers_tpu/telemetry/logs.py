@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import json
 import sys
-import threading
 import time
+
+from ..lockcheck import make_lock
 
 
 class JsonLogger:
@@ -28,7 +29,8 @@ class JsonLogger:
 
     def __init__(self, stream=None):
         self.stream = stream
-        self._log_lock = threading.Lock()
+        # witness-wrappable (DLLAMA_LOCKCHECK=1, lockcheck.py)
+        self._log_lock = make_lock("JsonLogger._log_lock")
 
     def emit(self, event: str, **fields) -> None:
         rec = {
@@ -42,6 +44,7 @@ class JsonLogger:
         stream = self.stream if self.stream is not None else sys.stderr
         with self._log_lock:
             try:
+                # dlint: ok[lock-blocking] serializing whole lines onto the stream is this lock's entire purpose; writers block on each other by design
                 print(line, file=stream, flush=True)
             except (ValueError, OSError):
                 pass  # closed stream at interpreter teardown: drop the line

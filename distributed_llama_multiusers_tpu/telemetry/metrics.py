@@ -24,9 +24,10 @@ threads; scrapes take one lock per metric, never all at once.
 from __future__ import annotations
 
 import math
-import threading
 from bisect import bisect_left
 from typing import Iterable
+
+from ..lockcheck import make_lock
 
 
 def log_buckets(lo: float, hi: float, per_decade: int = 4) -> tuple[float, ...]:
@@ -72,7 +73,7 @@ class Counter:
     def __init__(self, name: str, help_: str = ""):
         self.name = name
         self.help = help_
-        self._m_lock = threading.Lock()
+        self._m_lock = make_lock("Counter._m_lock")
         self._ctr_values: dict[tuple[tuple[str, str], ...], float] = {}
 
     def inc(self, value: float = 1.0, **labels: str) -> None:
@@ -106,7 +107,7 @@ class Gauge:
     def __init__(self, name: str, help_: str = ""):
         self.name = name
         self.help = help_
-        self._m_lock = threading.Lock()
+        self._m_lock = make_lock("Gauge._m_lock")
         self._gauge_values: dict[tuple[tuple[str, str], ...], float] = {}
 
     def set(self, value: float, **labels: str) -> None:
@@ -151,7 +152,7 @@ class Histogram:
             b >= a for a, b in zip(self.edges[1:], self.edges)
         ):
             raise ValueError("bucket edges must be strictly increasing")
-        self._m_lock = threading.Lock()
+        self._m_lock = make_lock("Histogram._m_lock")
         self._hist_counts = [0] * (len(self.edges) + 1)  # last = +Inf
         self._hist_sum = 0.0
         self._hist_n = 0
@@ -223,7 +224,7 @@ class MetricsRegistry:
     _dlint_guarded_by = {("_reg_lock",): ("_reg_metrics",)}
 
     def __init__(self):
-        self._reg_lock = threading.Lock()
+        self._reg_lock = make_lock("MetricsRegistry._reg_lock")
         self._reg_metrics: dict[str, object] = {}
 
     def _get_or_make(self, name: str, factory, kind):

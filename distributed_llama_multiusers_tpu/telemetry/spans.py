@@ -22,10 +22,11 @@ window, honestly labelled.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass
+
+from ..lockcheck import make_lock
 
 
 # span/instant names, for reference (docs/OBSERVABILITY.md lists them all):
@@ -68,7 +69,9 @@ class SpanTracer:
         # perf_counter origin: every event's ts is relative to this, so a
         # trace's µs timestamps start near 0 regardless of process uptime
         self.origin = time.perf_counter()
-        self._trace_lock = threading.Lock()
+        # witness-wrappable (DLLAMA_LOCKCHECK=1): the literal names the
+        # class-qualified declaration, cross-checked by dlint lock-order
+        self._trace_lock = make_lock("SpanTracer._trace_lock")
         self._trace_ring: deque[SpanEvent] = deque(maxlen=self.capacity)
         self._trace_dropped = 0
         self._trace_total = 0

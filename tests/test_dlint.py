@@ -634,6 +634,647 @@ def test_sharding_axis_default_axes_without_decl(tmp_path):
     assert checks_of(findings) == ["sharding-axis"]
 
 
+# -- lock-order (dlint v2 cross-file concurrency layer) ----------------------
+
+
+TWO_LOCK_CLASSES = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+
+    class B:
+        def __init__(self):
+            self._b_lock = threading.Lock()
+"""
+
+
+def test_lock_order_cycle_is_a_finding(tmp_path):
+    """Acceptance-criterion demo: two call sites taking the same two locks
+    in opposite orders is a lock-order cycle — the deadlock the test suite
+    only reproduces under exactly the wrong interleaving becomes a lint
+    failure instead."""
+    findings = run_on(tmp_path, {"m.py": TWO_LOCK_CLASSES + """
+        def forward(a, b):
+            with a._a_lock:
+                with b._b_lock:
+                    pass
+
+        def backward(a, b):
+            with b._b_lock:
+                with a._a_lock:
+                    pass
+    """})
+    assert "lock-order" in checks_of(findings)
+    msgs = " ".join(f.message for f in findings if f.check == "lock-order")
+    assert "cycle" in msgs and "A._a_lock" in msgs and "B._b_lock" in msgs
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path):
+    findings = run_on(tmp_path, {"m.py": TWO_LOCK_CLASSES + """
+        def one(a, b):
+            with a._a_lock:
+                with b._b_lock:
+                    pass
+
+        def two(a, b):
+            with a._a_lock:
+                with b._b_lock:
+                    pass
+    """})
+    assert findings == []
+
+
+def test_lock_order_cycle_across_files(tmp_path):
+    """The graph is cross-file: each direction of the inversion lives in
+    its own module and no single-file pass could see the cycle."""
+    findings = run_on(tmp_path, {
+        "serving/q.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._q_lock = threading.Lock()
+
+                def visit(self, tracer):
+                    with self._q_lock:
+                        with tracer._t_lock:
+                            pass
+        """,
+        "telemetry/t.py": """
+            import threading
+
+            class Tracer:
+                def __init__(self):
+                    self._t_lock = threading.Lock()
+
+                def visit(self, q):
+                    with self._t_lock:
+                        with q._q_lock:
+                            pass
+        """,
+    })
+    assert "lock-order" in checks_of(findings)
+
+
+def test_lock_order_one_level_call_edge(tmp_path):
+    """A `with lock:` body calling a method that takes another known lock
+    contributes an edge through the call — the cycle here is invisible to
+    any with-statement-only analysis."""
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._st_lock = threading.Lock()
+
+            def bump(self):
+                with self._st_lock:
+                    pass
+
+            def rev(self, q):
+                with self._st_lock:
+                    with q._q_lock:
+                        pass
+
+        class Queue:
+            def __init__(self):
+                self._q_lock = threading.Lock()
+
+            def popped(self, stats):
+                with self._q_lock:
+                    stats.bump()
+    """})
+    lock_order = [f for f in findings if f.check == "lock-order"]
+    assert lock_order, checks_of(findings)
+    assert any("via" in f.message or "cycle" in f.message for f in lock_order)
+
+
+def test_lock_order_self_reacquisition(tmp_path):
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._s_lock = threading.Lock()
+
+            def outer(self):
+                with self._s_lock:
+                    self.inner()
+
+            def inner(self):
+                with self._s_lock:
+                    pass
+    """})
+    assert checks_of(findings) == ["lock-order"]
+    assert "re-acquisition" in findings[0].message
+
+
+def test_lock_order_condition_alias_is_not_an_edge(tmp_path):
+    """Condition(self._lock) IS self._lock: nesting the condition inside
+    the lock's own guarded-by sibling must not read as a second lock."""
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self._cv = threading.Condition(self._lk)
+
+            def pop(self):
+                with self._cv:
+                    while True:
+                        self._cv.wait()
+    """})
+    assert findings == []
+
+
+def test_lock_order_waiver_suppresses_edge(tmp_path):
+    findings = run_on(tmp_path, {"m.py": TWO_LOCK_CLASSES + """
+        def forward(a, b):
+            with a._a_lock:
+                with b._b_lock:
+                    pass
+
+        def backward(a, b):
+            with b._b_lock:
+                # dlint: ok[lock-order] shutdown path: forward() provably quiesced before this runs
+                with a._a_lock:
+                    pass
+    """})
+    assert findings == []
+
+
+def test_lock_order_witness_name_mismatch(tmp_path):
+    """make_lock literals are the runtime witness's vocabulary; a literal
+    that drifts from its class-qualified declaration is a finding."""
+    findings = run_on(tmp_path, {"m.py": """
+        from distributed_llama_multiusers_tpu.lockcheck import make_lock
+
+        class Q:
+            def __init__(self):
+                self._lk = make_lock("SomethingElse._lk")
+    """})
+    assert checks_of(findings) == ["lock-order"]
+    assert "does not match" in findings[0].message
+
+
+def test_real_lock_decls_are_collected():
+    """Rot-guard: the real declarations the concurrency checks key on
+    still exist, under their witness names, with the QosQueue condition
+    aliased to its lock."""
+    from distributed_llama_multiusers_tpu.analysis.lockgraph import scan_paths
+
+    model = scan_paths([PACKAGE_ROOT])
+    model.ensure_semantics()
+    for qual in (
+        "QosQueue._lock", "EngineStats.lock", "SpanTracer._trace_lock",
+        "JsonLogger._log_lock", "Counter._m_lock", "Gauge._m_lock",
+        "Histogram._m_lock", "MetricsRegistry._reg_lock", "native._lock",
+    ):
+        assert qual in model.decls, f"lock declaration rotted: {qual}"
+    assert model.canonical("QosQueue._not_empty") == "QosQueue._lock"
+
+
+# -- lock-blocking ------------------------------------------------------------
+
+
+def test_lock_blocking_flags_broadcast_under_lock(tmp_path):
+    """'Never broadcast under a lock', mechanized: a control-packet send
+    while holding any known lock serializes every pod process on one
+    host's lock hold."""
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+
+        class Root:
+            def __init__(self):
+                self._r_lock = threading.Lock()
+
+            def bad(self, plane, pkt):
+                with self._r_lock:
+                    plane.send_decode(pkt)
+    """})
+    assert checks_of(findings) == ["lock-blocking"]
+    assert "send" in findings[0].message
+
+
+def test_lock_blocking_flags_observer_call_under_lock(tmp_path):
+    """The PR 5 wait-observer rule, mechanized: observer/hook callbacks
+    run OUTSIDE the queue lock."""
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._wq_lock = threading.Lock()
+                self._on_pop_wait = None
+
+            def bad_pop(self, wait):
+                with self._wq_lock:
+                    self._on_pop_wait(wait)
+
+            def good_pop(self, wait):
+                with self._wq_lock:
+                    observer = self._on_pop_wait
+                return observer(wait)
+    """})
+    assert checks_of(findings) == ["lock-blocking"]
+    assert "observer" in findings[0].message
+
+
+def test_lock_blocking_flags_sleep_result_and_foreign_wait(tmp_path):
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._w_lock = threading.Lock()
+                self._done = threading.Event()
+
+            def bad_sleep(self):
+                with self._w_lock:
+                    time.sleep(0.5)
+
+            def bad_future(self, fut):
+                with self._w_lock:
+                    return fut.result()
+
+            def bad_foreign_wait(self):
+                with self._w_lock:
+                    self._done.wait(5.0)
+    """})
+    assert checks_of(findings) == ["lock-blocking"] * 3
+
+
+def test_lock_blocking_own_condition_wait_is_fine(tmp_path):
+    """cv.wait on the condition built over the held lock releases it —
+    the one legitimate blocking-under-lock."""
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._bq_lock = threading.Lock()
+                self._ready = threading.Condition(self._bq_lock)
+                self._n = 0
+
+            def pop(self):
+                with self._ready:
+                    while self._n == 0:
+                        self._ready.wait()
+    """})
+    assert findings == []
+
+
+def test_lock_blocking_one_level_call_expansion(tmp_path):
+    """Calling a function that directly blocks, with the lock held, holds
+    the lock across the block just the same — flagged at the call site."""
+    findings = run_on(tmp_path, {"m.py": """
+        import subprocess
+        import threading
+
+        _build_lock = threading.Lock()
+
+        def compile_it():
+            subprocess.run(["cc", "x.c"], check=True)
+
+        def build():
+            with _build_lock:
+                compile_it()
+    """})
+    assert checks_of(findings) == ["lock-blocking"]
+    assert "callee blocks" in findings[0].message
+
+
+def test_lock_blocking_host_sync_set_under_lock(tmp_path):
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+        import numpy as np
+
+        class E:
+            def __init__(self):
+                self._e_lock = threading.Lock()
+
+            def bad(self, logits):
+                with self._e_lock:
+                    return np.asarray(logits)
+    """})
+    assert checks_of(findings) == ["lock-blocking"]
+
+
+# -- lock-atomicity -----------------------------------------------------------
+
+GUARDED_DEPTH = """
+    import threading
+
+    class Q:
+        _dlint_guarded_by = {("_at_lock",): ("_depth",)}
+
+        def __init__(self):
+            self._at_lock = threading.Lock()
+            self._depth = 0
+"""
+
+
+def test_lock_atomicity_flags_split_rmw(tmp_path):
+    """Acceptance-criterion demo: read under one hold, write under a
+    later hold — each section is individually locked (guarded-by green)
+    yet the interleaving loses updates."""
+    findings = run_on(tmp_path, {"m.py": GUARDED_DEPTH + """
+        def shrink(q):
+            with q._at_lock:
+                d = q._depth
+            with q._at_lock:
+                q._depth = d - 1
+    """})
+    assert checks_of(findings) == ["lock-atomicity"]
+    assert "straddles" in findings[0].message
+
+
+def test_lock_atomicity_check_then_act_variant(tmp_path):
+    findings = run_on(tmp_path, {"m.py": GUARDED_DEPTH + """
+        def maybe_shrink(q):
+            with q._at_lock:
+                has_items = q._depth > 0
+            if has_items:
+                with q._at_lock:
+                    q._depth -= 1
+    """})
+    assert checks_of(findings) == ["lock-atomicity"]
+
+
+def test_lock_atomicity_single_section_is_clean(tmp_path):
+    """The shipped shape: read-modify-write folded into one hold; two
+    disjoint WRITE-only sections are also fine (each += is atomic under
+    its own hold)."""
+    findings = run_on(tmp_path, {"m.py": GUARDED_DEPTH + """
+        def shrink(q):
+            with q._at_lock:
+                q._depth = q._depth - 1
+
+        def bump_twice(q, a, b):
+            with q._at_lock:
+                q._depth += a
+            with q._at_lock:
+                q._depth += b
+    """})
+    assert findings == []
+
+
+def test_lock_atomicity_waiver(tmp_path):
+    findings = run_on(tmp_path, {"m.py": GUARDED_DEPTH + """
+        def optimistic(q):
+            with q._at_lock:
+                d = q._depth
+            with q._at_lock:
+                # dlint: ok[lock-atomicity] revalidated: d is a hint, the write re-checks under the lock
+                q._depth = min(d, q._depth)
+    """})
+    assert findings == []
+
+
+# -- pod-broadcast ------------------------------------------------------------
+
+
+def test_pod_broadcast_flags_raise_between_send_and_pair(tmp_path):
+    """Acceptance-criterion demo: a raise reachable after the packet went
+    out but before the root's paired engine call — workers enter the
+    collective the root never dispatches; the pod hangs."""
+    findings = run_on(tmp_path, {"parallel/multihost.py": """
+        class RootControlEngine:
+            def decode(self, tokens):
+                self._plane.send_decode(tokens)
+                if not tokens:
+                    raise ValueError("empty decode batch")
+                return self._engine.decode(tokens)
+    """})
+    assert checks_of(findings) == ["pod-broadcast"]
+    assert "raise" in findings[0].message and "deadlock" in findings[0].message
+
+
+def test_pod_broadcast_flags_early_return(tmp_path):
+    findings = run_on(tmp_path, {"parallel/multihost.py": """
+        class RootControlEngine:
+            def prefill(self, tokens):
+                self._plane.send_prefill(tokens)
+                if len(tokens) > 512:
+                    return None
+                return self._engine.prefill(tokens)
+    """})
+    assert checks_of(findings) == ["pod-broadcast"]
+    assert "early return" in findings[0].message
+
+
+def test_pod_broadcast_validate_first_is_clean(tmp_path):
+    """The shipped shape: validation (raises) precedes the broadcast, the
+    pair is the next engine call, and a return CONTAINING the pair is the
+    pair, not an escape."""
+    findings = run_on(tmp_path, {"parallel/multihost.py": """
+        class RootControlEngine:
+            def decode(self, tokens):
+                if not tokens:
+                    raise ValueError("empty decode batch")
+                self._plane.send_decode(tokens)
+                return self._engine.decode(tokens)
+
+            def prefill(self, tokens, chunk):
+                for off in range(0, len(tokens), chunk):
+                    part = tokens[off : off + chunk]
+                    self._plane.send_prefill(part)
+                    out = self._engine.prefill(part)
+                return out
+
+            def stop_workers(self):
+                self._plane.send_stop()
+    """})
+    assert findings == []
+
+
+def test_pod_broadcast_scoped_to_multihost(tmp_path):
+    """The same shape outside parallel/multihost.py is not this check's
+    business."""
+    findings = run_on(tmp_path, {"parallel/other.py": """
+        class RootControlEngine:
+            def decode(self, tokens):
+                self._plane.send_decode(tokens)
+                raise ValueError("nope")
+    """})
+    assert "pod-broadcast" not in checks_of(findings)
+
+
+def test_pod_broadcast_real_sites_still_exist():
+    """Rot-guard: the real RootControlEngine still broadcasts through
+    self._plane.send_* with self._engine pairs — the exact spellings the
+    check keys on. If this fails, the check went blind, not green."""
+    import ast as ast_mod
+
+    src = (PACKAGE_ROOT / "parallel" / "multihost.py").read_text()
+    tree = ast_mod.parse(src)
+    sends = pairs = 0
+    for node in ast_mod.walk(tree):
+        if isinstance(node, ast_mod.Call):
+            spelled = ast_mod.unparse(node.func)
+            if spelled.startswith("self._plane.send_"):
+                sends += 1
+            elif spelled.startswith("self._engine."):
+                pairs += 1
+    assert sends >= 8, f"only {sends} broadcast sites found"
+    assert pairs >= 8, f"only {pairs} engine-pair sites found"
+    assert "machine-checked" in src.splitlines()[0] or "pod-broadcast" in src
+
+
+def test_pod_broadcast_return_after_pairless_send_is_legal(tmp_path):
+    """OP_STOP-style ops replay no device program: an explicit trailing
+    return after a pair-less broadcast is its normal shape (only a raise
+    still flags — the packet is already out)."""
+    findings = run_on(tmp_path, {"parallel/multihost.py": """
+        class RootControlEngine:
+            def stop_workers(self):
+                self._plane.send_stop()
+                return
+
+            def bad_reset(self, ok):
+                self._plane.send_stats_reset()
+                if not ok:
+                    raise RuntimeError("too late: the packet is out")
+    """})
+    assert checks_of(findings) == ["pod-broadcast"]
+    assert "raise" in findings[0].message
+
+
+def test_pod_broadcast_ignores_nested_def_returns(tmp_path):
+    """A closure's return is its own call stack, not an escape of the
+    proxy method."""
+    findings = run_on(tmp_path, {"parallel/multihost.py": """
+        class RootControlEngine:
+            def decode(self, tokens):
+                self._plane.send_decode(tokens)
+
+                def fmt(x):
+                    return x + 1
+                return self._engine.decode(tokens, fmt)
+    """})
+    assert findings == []
+
+
+def test_lock_blocking_local_lock_name_does_not_misbind(tmp_path):
+    """A function-local `lock = threading.Lock()` is not shared state and
+    must not resolve to an unrelated class's declared lock of the same
+    attribute name (the EngineStats.lock mis-bind)."""
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+        import time
+
+        class Stats:
+            def __init__(self):
+                self.lock = threading.Lock()
+
+        def scratch():
+            lock = threading.Lock()
+            with lock:
+                time.sleep(0.1)
+    """})
+    assert findings == []
+
+
+def test_lock_blocking_observer_attribute_spellings(tmp_path):
+    """The documented observer vocabulary covers attribute callees too:
+    renaming `_on_pop_wait` to `_wait_observer` must not retire the
+    machine-checked wait-observer rule."""
+    findings = run_on(tmp_path, {"m.py": """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._ob_lock = threading.Lock()
+                self._wait_observer = None
+                self._done_callback = None
+
+            def bad_a(self, w):
+                with self._ob_lock:
+                    self._wait_observer(w)
+
+            def bad_b(self, w):
+                with self._ob_lock:
+                    self._done_callback(w)
+    """})
+    assert checks_of(findings) == ["lock-blocking", "lock-blocking"]
+
+
+# -- CLI output formats & the lock-order graph dump ---------------------------
+
+
+def test_cli_format_github_annotations(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text("import time\nT = time.time()\n")
+    rc = dlint_main([str(tmp_path), "--no-baseline", "--format", "github"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert out.startswith("::error file=")
+    assert "title=dlint[clock]" in out
+    assert ",line=2," in out
+
+
+def test_cli_format_sarif(tmp_path, capsys):
+    import json
+
+    (tmp_path / "mod.py").write_text("import time\nT = time.time()\n")
+    rc = dlint_main([str(tmp_path), "--no-baseline", "--format", "sarif"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "dlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"lock-order", "lock-blocking", "lock-atomicity",
+            "pod-broadcast", "clock"} <= rule_ids
+    assert run["results"][0]["ruleId"] == "clock"
+    line = run["results"][0]["locations"][0]["physicalLocation"]["region"]["startLine"]
+    assert line == 2
+
+
+def test_cli_format_sarif_clean_tree_emits_document(capsys):
+    assert dlint_main(["--format", "sarif"]) == 0
+    import json
+
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_graph_dumps_dot(capsys):
+    assert dlint_main(["--graph"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("digraph dlint_lock_order")
+    assert '"QosQueue._lock"' in out
+    assert "QosQueue._not_empty" in out  # the alias stays visible
+    assert '"EngineStats.lock"' in out
+
+
+def test_cli_graph_shows_edges_and_waived_style(tmp_path, capsys):
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._ga_lock = threading.Lock()
+
+        class B:
+            def __init__(self):
+                self._gb_lock = threading.Lock()
+
+        def nest(a, b):
+            with a._ga_lock:
+                # dlint: ok[lock-order] drawn dashed, not cycle-checked
+                with b._gb_lock:
+                    pass
+    """))
+    assert dlint_main([str(tmp_path), "--graph"]) == 0
+    out = capsys.readouterr().out
+    assert '"A._ga_lock" -> "B._gb_lock"' in out
+    assert "style=dashed" in out
+
+
 # -- waiver hygiene ----------------------------------------------------------
 
 

@@ -1,0 +1,309 @@
+"""Runtime lock-order witness (DLLAMA_LOCKCHECK=1, lockcheck.py).
+
+Three layers, mirroring the test_dlint.py contract:
+
+- **witness unit tests** — the wrapper records per-thread chains,
+  non-blocking probes stay silent, Condition integration keeps the
+  chain honest;
+- **seeded inversion fixtures** — the witness actually FIRES: on a
+  runtime-observed order inverted later, on a statically declared order
+  inverted at first touch, and on re-entry of a non-reentrant lock;
+- **the tier-1 gate** — the real QoS + telemetry paths run CLEAN under
+  the witness, in-process (fresh witness, static seed included) and as
+  a subprocess rerun of their suites with DLLAMA_LOCKCHECK=1 in the
+  environment (so every lock those suites construct is wrapped).
+
+Pure stdlib apart from the subprocess rerun.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from distributed_llama_multiusers_tpu import lockcheck
+from distributed_llama_multiusers_tpu.lockcheck import (
+    LockOrderViolation,
+    WitnessLock,
+    make_lock,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def witness_on():
+    """Force the witness on (fresh order graph, static seed applied on
+    first use) and restore the env-driven default afterwards."""
+    lockcheck.force(True, fresh=True)
+    try:
+        yield lockcheck.witness()
+    finally:
+        lockcheck.force(None, fresh=True)
+
+
+# -- wiring -------------------------------------------------------------------
+
+
+def test_disabled_returns_plain_lock():
+    lockcheck.force(False, fresh=True)
+    try:
+        lk = make_lock("QosQueue._lock")
+        assert not isinstance(lk, WitnessLock)
+        assert isinstance(lk, type(threading.Lock()))
+    finally:
+        lockcheck.force(None, fresh=True)
+
+
+def test_enabled_wraps_and_tracks_chain(witness_on):
+    a = make_lock("Fix.a")
+    b = make_lock("Fix.b")
+    assert isinstance(a, WitnessLock)
+    with a:
+        with b:
+            assert witness_on.held() == ("Fix.a", "Fix.b")
+    assert witness_on.held() == ()
+
+
+def test_nonblocking_probe_does_not_fire(witness_on):
+    """Condition._is_owned probes held locks with acquire(False) — the
+    witness must not mistake the probe for a deadlocking re-entry."""
+    a = make_lock("Fix.a")
+    with a:
+        assert a.acquire(False) is False
+    assert a.acquire(False) is True
+    a.release()
+    assert witness_on.held() == ()
+
+
+def test_timeout_acquire_pops_chain(witness_on):
+    a = make_lock("Fix.a")
+    a.acquire()
+    done = []
+
+    def contender():
+        got = a.acquire(timeout=0.05)
+        done.append(got)
+
+    t = threading.Thread(target=contender)
+    t.start()
+    t.join()
+    assert done == [False]
+    a.release()
+    assert witness_on.held() == ()
+
+
+# -- the seeded inversion fixtures: the witness FIRES -------------------------
+
+
+def test_runtime_inversion_fires(witness_on):
+    """The acceptance-criterion fixture: establish A->B at runtime, then
+    acquire B->A — the witness raises at the inverted acquire instead of
+    letting the schedule decide whether the pod hangs today."""
+    a = make_lock("Fix.a")
+    b = make_lock("Fix.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderViolation, match="inversion"):
+            with a:
+                pass
+    assert witness_on.held() == ()
+
+
+def test_static_seeded_order_fires_without_prior_observation(witness_on):
+    """An order only the STATIC graph knows (seeded, never observed in
+    this process) still fires on the first inverted acquire."""
+    witness_on.add_order("Decl.x", "Decl.y", site="static fixture:1")
+    x = make_lock("Decl.x")
+    y = make_lock("Decl.y")
+    with y:
+        with pytest.raises(LockOrderViolation, match="static fixture:1"):
+            with x:
+                pass
+
+
+def test_reentry_fires(witness_on):
+    a = make_lock("Fix.a")
+    with a:
+        with pytest.raises(LockOrderViolation, match="re-acquisition"):
+            a.acquire()
+
+
+def test_transitive_inversion_fires(witness_on):
+    """A->B and B->C established; acquiring A under C inverts through the
+    transitive closure, not just direct edges."""
+    a, b, c = (make_lock(f"Fix.{n}") for n in "abc")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(LockOrderViolation):
+            with a:
+                pass
+
+
+def test_violation_is_assertion_error():
+    """Test harnesses treat the witness verdict as a failed invariant."""
+    assert issubclass(LockOrderViolation, AssertionError)
+
+
+# -- the static seed matches the shipped declarations -------------------------
+
+
+def test_static_seed_vocabulary_matches_declarations(witness_on):
+    """The witness names (make_lock literals) and the static model's
+    class-qualified ids are one vocabulary — if a declaration is renamed
+    without its literal, dlint's lock-order check fails; if a make_lock
+    site disappears, this rot-guard does."""
+    import ast
+
+    from distributed_llama_multiusers_tpu.analysis.lockgraph import scan_paths
+
+    pkg = REPO_ROOT / "distributed_llama_multiusers_tpu"
+    model = scan_paths([pkg])
+    model.ensure_semantics()
+    literals = set()
+    for py in pkg.rglob("*.py"):
+        for node in ast.walk(ast.parse(py.read_text())):
+            if (
+                isinstance(node, ast.Call)
+                and getattr(node.func, "attr", getattr(node.func, "id", None))
+                == "make_lock"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                literals.add(node.args[0].value)
+    assert {
+        "QosQueue._lock", "EngineStats.lock", "SpanTracer._trace_lock",
+        "JsonLogger._log_lock", "Counter._m_lock", "Gauge._m_lock",
+        "Histogram._m_lock", "MetricsRegistry._reg_lock", "native._lock",
+    } <= literals
+    for name in literals:
+        assert name in model.decls, (
+            f"witness name {name!r} has no static declaration"
+        )
+
+
+# -- Condition integration (the QosQueue shape) -------------------------------
+
+
+def test_condition_over_witnessed_lock(witness_on):
+    lk = make_lock("Cond.q")
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cv:
+            while not hits:
+                if not cv.wait(timeout=1.0):
+                    return
+        hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    with cv:
+        hits.append("go")
+        cv.notify()
+    t.join(timeout=2)
+    assert not t.is_alive()
+    assert hits == ["go", "woke"]
+    assert witness_on.held() == ()
+
+
+# -- tier-1: the real QoS/telemetry paths run clean under the witness ---------
+
+
+def test_real_qos_and_telemetry_paths_clean(witness_on):
+    """Drive the real QosQueue (witnessed lock + condition), the wait
+    observer wired to the real Histogram (witnessed _m_lock), the span
+    tracer, the JSON logger, and EngineStats — concurrently — with the
+    static seed active. Any nesting that contradicts the computed order
+    raises out of a worker and fails the test."""
+    from distributed_llama_multiusers_tpu.runtime.engine import EngineStats
+    from distributed_llama_multiusers_tpu.serving.qos import QosQueue
+    from distributed_llama_multiusers_tpu.telemetry.hub import Telemetry
+
+    tel = Telemetry(trace_capacity=256)
+    q = QosQueue(capacity=256, quantum=32.0)
+    assert isinstance(q._lock, WitnessLock)
+    assert tel.bind_queue(q) is True  # observer runs outside the queue lock
+    stats = EngineStats()
+    assert isinstance(stats.lock, WitnessLock)
+
+    errors: list[BaseException] = []
+
+    class Req:
+        def __init__(self, i):
+            self.user_id = f"u{i % 3}"
+            self.priority = 1
+            self.max_tokens = 8
+            self.submitted_at = None
+
+    def producer(i):
+        try:
+            for _ in range(50):
+                q.push(Req(i))
+                tel.tracer.instant("submitted", "queue")
+                tel.logger.emit("test", i=i)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    def consumer():
+        try:
+            for _ in range(100):
+                req = q.pop(timeout=1.0)
+                if req is None:
+                    return
+                with stats.lock:
+                    stats.decode_steps += 1
+                q.stats()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=producer, args=(i,)) for i in range(2)]
+    threads += [threading.Thread(target=consumer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    q.drain()
+    assert not errors, errors
+    assert tel.queue_wait.count > 0  # the observer really ran
+    assert witness_on.held() == ()
+
+
+def test_qos_and_telemetry_suites_clean_under_lockcheck():
+    """The tier-1 fixture the issue asks for: rerun the QoS + telemetry
+    suites in a subprocess with DLLAMA_LOCKCHECK=1, so EVERY lock they
+    construct is witness-wrapped (static seed included). A lock-order
+    regression on those paths fails this test even when the interleaving
+    never actually deadlocks."""
+    env = dict(os.environ)
+    env["DLLAMA_LOCKCHECK"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/test_qos.py", "tests/test_telemetry.py",
+            "-q", "-p", "no:cacheprovider",
+        ],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"QoS/telemetry suites failed under DLLAMA_LOCKCHECK=1:\n"
+        f"{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+    )
