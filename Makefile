@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint lockgraph sanitize clean
+.PHONY: all native test verify lint lockgraph sanitize dryrun clean
 
 all: native
 
@@ -43,6 +43,15 @@ verify: native
 LINT_FORMAT := $(if $(filter true,$(GITHUB_ACTIONS)),--format github,)
 lint:
 	python -m distributed_llama_multiusers_tpu.analysis $(LINT_FORMAT)
+
+# One-command serving-path parity gate on the 8-virtual-device CPU mesh:
+# scheduler decode / chunked prefill / speculative verify / multi-step /
+# prefix cache / pipelined+fused churn (0 flushes) all stream-identical
+# to the mesh-free engine, plus sharded + pipeline-parallel train steps.
+# Banks MULTICHIP_r06.json. Run it before shipping mesh/collective/
+# serving-dispatch changes — it is the CPU stand-in for a real pod.
+dryrun:
+	python scripts/dryrun_multichip.py
 
 # Reviewer aid for new lock/broadcast code (ROADMAP items 2-4): the
 # statically computed lock-order DAG, DOT on stdout (waived edges

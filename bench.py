@@ -21,6 +21,11 @@ single phase can eat the budget:
                and the pipeline flush count under churn — the stall-free
                admission path (fused prefill+decode dispatch) keeps
                flushes ~0 while requests join mid-chain
+  pod_serving — the same churn workload on a pure-TP mesh(tp=N): Q40
+               planes TP-sharded (each chip reads 1/N of the weights per
+               token), mesh-native pipelined+fused dispatch, ring-
+               overlapped activation sync; reports tok/s/chip against
+               the 200 north star plus the measured sync-ms split
   ablations  — packed Q40 via XLA dequant, dense bf16 (what the kernel buys)
   8b         — the BASELINE north star: Llama-3.1-8B Q40 decode tok/s vs
                200 tok/s/chip (BASELINE.md), now on by default
@@ -647,6 +652,42 @@ def _phase_serving(config, small):
     }
 
 
+def _run_churn(sched, n_requests, max_tokens, interval_mean=0.05, seed=7):
+    """Poisson-arrival churn against a STARTED-then-stopped scheduler:
+    deterministic seeded arrivals, half greedy / half sampled. Returns
+    (total generated tokens, wall seconds). Shared by the single-chip
+    ``serving_churn`` phase and the mesh ``pod_serving`` phase so the two
+    workloads cannot drift apart."""
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.runtime.scheduler import Request
+
+    rng = np.random.default_rng(seed)
+    intervals = rng.exponential(interval_mean, n_requests)
+    reqs = [
+        Request(
+            prompt="churn benchmark prompt " * 2,
+            max_tokens=max_tokens,
+            temperature=0.0 if i % 2 == 0 else 0.8,
+            seed=200 + i,
+        )
+        for i in range(n_requests)
+    ]
+    sched.start()
+    t0 = time.perf_counter()
+    try:
+        for r, dt in zip(reqs, intervals):
+            time.sleep(dt)
+            sched.submit(r)
+        for r in reqs:
+            r.future.result(timeout=600)
+    finally:
+        sched.stop()
+    wall = time.perf_counter() - t0
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    return sum(len(r.generated_tokens) for r in reqs), wall
+
+
 def _phase_serving_churn(config, small):
     """Poisson-arrival churn against the REAL scheduler: requests join a
     live serving loop mid-generation (the regime the fused prefill+decode
@@ -688,31 +729,7 @@ def _phase_serving_churn(config, small):
     # measured window: TTFT under churn must not read as XLA compile time
     warmup_engine(engine, spec=False, multi_step=sched.multi_step)
 
-    rng = np.random.default_rng(7)
-    intervals = rng.exponential(0.05, n_requests)
-    reqs = [
-        Request(
-            prompt="churn benchmark prompt " * 2,
-            max_tokens=max_tokens,
-            temperature=0.0 if i % 2 == 0 else 0.8,
-            seed=200 + i,
-        )
-        for i in range(n_requests)
-    ]
-
-    sched.start()
-    t0 = time.perf_counter()
-    try:
-        for r, dt in zip(reqs, intervals):
-            time.sleep(dt)
-            sched.submit(r)
-        for r in reqs:
-            r.future.result(timeout=600)
-    finally:
-        sched.stop()
-    wall = time.perf_counter() - t0
-    assert all(r.error is None for r in reqs), [r.error for r in reqs]
-    toks = sum(len(r.generated_tokens) for r in reqs)
+    toks, wall = _run_churn(sched, n_requests, max_tokens)
     stats = engine.stats.snapshot()
 
     # percentiles from the serving histogram registry (TTFT = submit ->
@@ -763,6 +780,124 @@ def _phase_serving_churn(config, small):
         ),
         "serving_churn_prefix_hits": stats["prefix_hits"],
         **trace_extra,
+    }
+
+
+def _phase_pod_serving(config, small):
+    """Pod-native serving: the churn workload (the `serving_churn` phase's
+    exact arrival process) on a pure-TP mesh(tp=N) with the Q40 planes
+    TP-sharded — each chip reads 1/N of the weights per token, the explicit
+    route past the single-chip HBM roofline (BASELINE.md: ~182 tok/s
+    theoretical, 200 tok/s/chip north star needs the pod). The engine is
+    mesh-native end to end: sharded KV (cache_shardings), replicated token
+    carry, pipelined + fused-admission dispatches, and the TP activation
+    sync ring-overlapped with the dequant matmul (DLLAMA_RING_SYNC;
+    ops/ring_collective.py). Honors DLLAMA_DEQUANT so the in-bench kernel
+    sweep can bank the kernel A/B and the pod number in one unattended
+    pass. Reports `pod_serving_tok_s_per_chip` against the 200 north star
+    plus the measured per-step sync split (engine.measured_sync_stats).
+
+    Off-TPU (CPU smoke) the mesh is the 8-virtual-device test mesh; with a
+    single real chip tp degenerates to 1 (the mesh-native path still runs
+    — dispatch under GSPMD — but the sync is trivial and the per-chip
+    number equals the aggregate)."""
+    import jax
+
+    from distributed_llama_multiusers_tpu.ops.ring_collective import (
+        ring_sync_enabled,
+    )
+    from distributed_llama_multiusers_tpu.parallel import (
+        MeshPlan,
+        make_mesh,
+        validate_mesh_for_config,
+    )
+    from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.runtime.engine import warmup_engine
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+    from distributed_llama_multiusers_tpu.telemetry import Telemetry
+
+    n_dev = len(jax.devices())
+    # largest valid pure-TP width, by the validator itself (the single
+    # source of truth for mesh constraints — a new rule there must degrade
+    # this phase to a smaller tp, not crash it)
+    tp, plan = 1, MeshPlan(tp=1)
+    for cand in range(min(n_dev, config.n_kv_heads), 0, -1):
+        try:
+            validate_mesh_for_config(config, MeshPlan(tp=cand))
+        except ValueError:
+            continue
+        tp, plan = cand, MeshPlan(tp=cand)
+        break
+    mesh = make_mesh(plan)
+    print(f"[bench] pod_serving: mesh(tp={tp}) over {n_dev} device(s), "
+          f"ring_sync={'on' if ring_sync_enabled() else 'off'}",
+          file=sys.stderr, flush=True)
+
+    if jax.devices()[0].platform == "tpu":
+        params = shard_params(_device_packed_params(config), mesh)
+    else:
+        params = shard_params(_random_packed_params(config), mesh)
+
+    n_lanes = 4 if small else 8
+    n_requests = 10 if small else 48
+    max_tokens = 10 if small else 48
+    engine = InferenceEngine(
+        config, params, n_lanes=n_lanes, prefill_buckets=(16,), mesh=mesh
+    )
+    tokenizer = _BenchTokenizer(config.vocab_size)
+    telemetry = Telemetry()
+    sched = ContinuousBatchingScheduler(
+        engine, tokenizer, speculative=False, telemetry=telemetry
+    )
+    # compiles every sharded program family per bucket (and AOT-compiles
+    # the decode step for the collective byte estimate) OUTSIDE the window
+    warmup_engine(engine, spec=False, multi_step=sched.multi_step)
+    coll = engine.collective_stats()
+
+    toks, wall = _run_churn(sched, n_requests, max_tokens)
+    stats = engine.stats.snapshot()
+
+    # measured per-step sync split (profiler probe; rewrites cache slot 0,
+    # safe after the workload) — fed into the telemetry histogram so the
+    # bench numbers and a pod's scraped dllama_sync_seconds reconcile
+    probe_steps = 4
+    sync = engine.measured_sync_stats(steps=probe_steps)
+    telemetry.observe_sync_probe(sync, steps=probe_steps)
+
+    def pct_ms(hist, q):
+        v = hist.quantile(q)
+        return None if v is None else round(v * 1e3, 2)
+
+    tok_s = toks / wall
+    return {
+        "pod_serving_tok_s": round(tok_s, 2),
+        "pod_serving_tok_s_per_chip": round(tok_s / tp, 2),
+        "pod_serving_northstar_frac": round(tok_s / tp / 200.0, 4),
+        "pod_serving_mesh_tp": tp,
+        "pod_serving_devices": n_dev,
+        "pod_serving_ring_sync": ring_sync_enabled(),
+        "pod_serving_dequant_mode": os.environ.get("DLLAMA_DEQUANT", "v4"),
+        "pod_serving_requests": n_requests,
+        "pod_serving_lanes": n_lanes,
+        "pod_serving_ttft_ms_p50": pct_ms(telemetry.ttft, 0.5),
+        "pod_serving_ttft_ms_p95": pct_ms(telemetry.ttft, 0.95),
+        "pod_serving_tbt_ms_p50": pct_ms(telemetry.tbt, 0.5),
+        # the mesh-native async chain held under churn: admissions rode
+        # fused dispatches, zero aborts
+        "pod_serving_pipeline_flushes": stats["pipeline_flushes"],
+        "pod_serving_fused_steps": stats["fused_steps"],
+        "pod_serving_pipeline_dispatches": stats["pipeline_dispatches"],
+        # static per-step collective payload (post-SPMD HLO) + measured split
+        "pod_serving_sync_bytes_per_decode": coll.get("total_bytes", 0),
+        "pod_serving_sync_collectives_per_decode": coll.get("n_collectives", 0),
+        "pod_serving_sync_bytes_total": stats["sync_bytes_total"],
+        "pod_serving_step_ms": sync.get("step_ms"),
+        "pod_serving_sync_ms": sync.get("sync_ms"),
+        "pod_serving_sync_frac": sync.get("sync_frac"),
+        "pod_serving_sync_source": sync.get("source"),
     }
 
 
@@ -987,11 +1122,17 @@ def child_main() -> None:
     # CPU runs must strip the TPU PJRT plugin BEFORE backend discovery: this
     # box's sitecustomize registers one whose init dials a network tunnel,
     # and it blocks discovery even under JAX_PLATFORMS=cpu (see
-    # utils/testing.force_cpu_mesh — the same reason round 1's bench hung)
+    # utils/testing.force_cpu_mesh — the same reason round 1's bench hung).
+    # The pod_serving smoke needs the 8-virtual-device mesh (the tests'
+    # standard TP fixture); every other phase runs single-device.
     if os.environ.get("BENCH_FORCE_CPU") == "1" or os.environ.get("JAX_PLATFORMS") == "cpu":
         from distributed_llama_multiusers_tpu.utils.testing import force_cpu_mesh
 
-        force_cpu_mesh(n_devices=1)
+        force_cpu_mesh(
+            n_devices=8
+            if os.environ.get("BENCH_PHASE") == "pod_serving"
+            else 1
+        )
 
     import jax
 
@@ -1022,6 +1163,8 @@ def child_main() -> None:
         result = _phase_serving(config, small)
     elif phase == "serving_churn":
         result = _phase_serving_churn(config, small)
+    elif phase == "pod_serving":
+        result = _phase_pod_serving(config, small)
     elif phase == "ablations":
         result = _phase_ablations(config, small)
     elif phase == "8b":
@@ -1178,8 +1321,8 @@ def main() -> None:
     # decodes), and a timeout kill mid-TPU-RPC has wedged the tunnel for
     # every phase after it (round 5) — order so a wedge costs nothing.
     for phase, cap in (
-        ("serving", 420.0), ("serving_churn", 300.0), ("8b", 500.0),
-        ("ablations", 420.0), ("longctx", 300.0),
+        ("serving", 420.0), ("serving_churn", 300.0), ("pod_serving", 300.0),
+        ("8b", 500.0), ("ablations", 420.0), ("longctx", 300.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)
         if budget < 90:
@@ -1266,6 +1409,25 @@ def main() -> None:
                     break  # tunnel died mid-sweep: stop burning budget
         if sweep:
             bank({"kernel_sweep": sweep})
+
+        # pod serving under the ADOPTED kernel knobs (if the sweep found a
+        # winner): one unattended pass banks the kernel A/B AND the pod
+        # number for the same configuration — the next tunnel window needs
+        # no second run to connect them
+        if best_env and not tunnel_dead:
+            budget = min(300.0, deadline - time.monotonic() - 10)
+            if budget >= 90:
+                result, err = _run_child(
+                    {"BENCH_PHASE": "pod_serving", **best_env}, budget
+                )
+                if result is not None:
+                    bank({"pod_serving_swept": {
+                        **result, "knobs": merged.get("kernel_knobs"),
+                    }})
+                else:
+                    errors.append(f"pod_serving_swept: {err}")
+            else:
+                errors.append("pod_serving_swept: skipped (out of budget)")
 
         # parity last — see the phase-order comment above. It runs under
         # the ADOPTED sweep knobs (if any), so the token-identity gate

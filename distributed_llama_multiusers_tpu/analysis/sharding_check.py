@@ -31,9 +31,17 @@ DEFAULT_AXES = ("dp", "pp", "tp", "sp", "ep")
 SPEC_CALLS = {"P", "PartitionSpec"}
 COLLECTIVE_CALLS = {
     "psum", "pmax", "pmin", "pmean", "ppermute", "all_gather",
-    "all_to_all", "axis_index", "psum_scatter",
+    "all_to_all", "axis_index", "psum_scatter", "axis_size",
+    # ring collectives (ops/ring_collective.py) take the mesh axis name as
+    # a plain argument, exactly like the lax primitives they wrap — a
+    # misspelled axis would otherwise only die at trace time on a real mesh
+    "ring_reduce_scatter", "ring_all_gather", "ring_all_gather_q80",
+    "ring_all_reduce", "ring_sync_matmul",
 }
 AXIS_KWARGS = {"axis_name", "axis_names"}
+# axis= is validated ONLY on known collective calls: it is the ubiquitous
+# numpy/jnp kwarg everywhere else, where a string value is never a mesh axis
+COLLECTIVE_AXIS_KWARG = "axis"
 
 
 class ShardingAxisChecker(Checker):
@@ -96,7 +104,9 @@ class ShardingAxisChecker(Checker):
             # mesh.shape.get("tp", 1) / mesh_shape.get("tp", 1)
             yield from self._validate_expr(sf, node.args[0], axes, src)
         for kw in node.keywords:
-            if kw.arg in AXIS_KWARGS:
+            if kw.arg in AXIS_KWARGS or (
+                kw.arg == COLLECTIVE_AXIS_KWARG and name in COLLECTIVE_CALLS
+            ):
                 yield from self._validate_expr(sf, kw.value, axes, src)
 
     def _validate_expr(self, sf, expr: ast.AST, axes, src):

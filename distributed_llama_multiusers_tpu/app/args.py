@@ -124,6 +124,16 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "churn. 'off' restores the pre-fused behavior: an "
                         "admission exits the chain to the synchronous "
                         "admit+prefill path (escape hatch)")
+    p.add_argument("--ring-sync", default=None, choices=["on", "off"],
+                   help="pure-TP mesh serving: overlap the wo/w2 TP "
+                        "activation sync with the dequant matmul as a ring "
+                        "reduce-scatter + all-gather (chunked hops XLA "
+                        "hides under compute; Q80 wire when "
+                        "--buffer-float-type q80 engages) instead of "
+                        "XLA's sequential post-matmul all-reduce. Default "
+                        "on (DLLAMA_RING_SYNC env equivalent); 'off' "
+                        "restores the plain psum sync bit-for-bit "
+                        "(escape hatch)")
     # observability (telemetry/, docs/OBSERVABILITY.md)
     p.add_argument("--trace-path", default=None,
                    help="serving: write the request-lifecycle span ring as "

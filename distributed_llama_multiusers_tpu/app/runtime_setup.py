@@ -150,6 +150,30 @@ def load_stack(args, n_lanes: int | None = None):
                   "(--buffer-float-type q80 on a tp mesh)")
     elif emulate_q80:
         log("🔶", "Q80 activation-cast emulation enabled (--buffer-float-type q80)")
+    # ring-overlapped TP activation sync (ops/ring_collective.py): CLI flag
+    # overrides the DLLAMA_RING_SYNC env default; the log uses the same
+    # predicate llama_forward does, so what is announced is what runs
+    from ..ops.ring_collective import (
+        ring_sync_engages,
+        ring_sync_supported,
+        set_ring_sync,
+    )
+
+    if getattr(args, "ring_sync", None) is not None:
+        set_ring_sync(args.ring_sync == "on")
+    if mesh is not None and ring_sync_engages(config, dict(mesh.shape)):
+        # mirror llama_forward's FULL gate (engages + per-output support,
+        # q80-wire blocks included — q80_sync_engages already guarantees the
+        # block divisibility today, but the log must not outlive that
+        # coincidence): what is announced is what runs
+        tp = dict(mesh.shape).get("tp", 1)
+        if ring_sync_supported(config.dim, tp, q80_sync):
+            synced = "wo" if config.n_experts > 0 else "wo/w2"
+            log("🔗", f"Ring TP sync: {synced} activation sync overlapped "
+                      "with the dequant matmul"
+                      + (" (Q80 wire)" if q80_sync else "")
+                      + " — DLLAMA_RING_SYNC=off / --ring-sync off to fall "
+                        "back to psum")
     if n_proc > 1 and mesh is None:
         print(
             "error: multi-host runs need a --workers mesh spec spanning the "

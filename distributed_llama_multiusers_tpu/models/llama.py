@@ -340,6 +340,34 @@ def llama_forward(
 
         # shared predicate with the runtime_setup startup log
         use_q80_sync = q80_sync_engages(h_cfg, dict(mesh.shape))
+    use_ring_sync = False
+    if mesh is not None:
+        from ..ops.ring_collective import (
+            ring_sync_engages,
+            ring_sync_matmul,
+            ring_sync_supported,
+        )
+
+        # ring-overlapped TP sync (default on, DLLAMA_RING_SYNC=off escape
+        # hatch): pure-TP meshes route the wo/w2 row-parallel matmuls
+        # through the chunked ring instead of GSPMD's post-matmul
+        # all-reduce; with q80_sync the gather half ships the Q80 wire
+        use_ring_sync = ring_sync_engages(h_cfg, dict(mesh.shape))
+
+    def synced_matmul(y, w):
+        """A row-parallel (col-sliced) wo/w2 matmul plus its TP sync:
+        ring-overlapped (optionally Q80-wire), Q80 psum_scatter+gather, or
+        the plain GSPMD matmul whose all-reduce XLA inserts."""
+        if use_ring_sync:
+            d_out = w.d_out if hasattr(w, "d_out") else w.shape[-1]
+            if ring_sync_supported(d_out, mesh.shape["tp"], use_q80_sync):
+                out = ring_sync_matmul(y, w, mesh, q80_wire=use_q80_sync)
+                # the Q80 wire quantizes ON the wire (the q80 branch's
+                # contract); the f32 wire keeps the output-side cast
+                return out if use_q80_sync else maybe_qdq(out)
+        if use_q80_sync:
+            return q80_sync_matmul(y, w, mesh)
+        return maybe_qdq(matmul(y, w))
 
     x = params.embedding[tokens]  # [B, T, dim]
     lane_idx = jnp.arange(b)[:, None]  # [B, 1]
@@ -388,13 +416,10 @@ def llama_forward(
             )
         attn = attn.reshape(b, t, n_heads * hd).astype(dtype)
 
-        if use_q80_sync:
-            # the sync-boundary quantization happens ON the wire (the gather
-            # half ships int8+scales), replacing the output-side qdq cast
-            x = x + q80_sync_matmul(maybe_qdq(attn), lp.wo, mesh)
-        else:
-            out = matmul(maybe_qdq(attn), lp.wo)
-            x = x + maybe_qdq(out)  # sync-boundary cast (ZQ pipe) + merge_add
+        # sync-boundary cast (ZQ pipe) + merge_add; with a compressed wire
+        # (q80/ring-q80) the quantization happens ON the wire instead of as
+        # an output-side qdq cast
+        x = x + synced_matmul(maybe_qdq(attn), lp.wo)
 
         y = rms_norm(x, lp.rms_ffn, eps)
         yq = maybe_qdq(y)
@@ -405,15 +430,10 @@ def llama_forward(
                 mesh=mesh,
             )
             x = x + maybe_qdq(d)
-        elif use_q80_sync:
-            g = act_fn(matmul(yq, lp.w1))
-            u = matmul(yq, lp.w3)
-            x = x + q80_sync_matmul(maybe_qdq(g * u), lp.w2, mesh)
         else:
             g = act_fn(matmul(yq, lp.w1))
             u = matmul(yq, lp.w3)
-            d = matmul(maybe_qdq(g * u), lp.w2)
-            x = x + maybe_qdq(d)
+            x = x + synced_matmul(maybe_qdq(g * u), lp.w2)
 
         return x, (k_cache, v_cache)
 

@@ -616,10 +616,23 @@ def _q40_mm_partition(interpret, w_dtype, mesh, arg_shapes, result_shape):
     def lower(x, packed, scales):
         y = _q40_mm_impl(x, packed, scales, interpret, w_dtype)
         if k_spec is not None:
-            y = jax.lax.psum(y, k_spec)
+            y = _contraction_sync(y, k_spec, mesh)
         return y
 
     return mesh, lower, out_sh, (x_sh, p_sh, s_sh)
+
+
+def _contraction_sync(y, k_spec, mesh):
+    """The col-sliced partial-sum sync: a ring all-reduce (n-1 chunk-sized
+    hops XLA overlaps with the surrounding compute — ops/ring_collective.py)
+    when the ring engages, else the plain psum. DLLAMA_RING_SYNC=off (or
+    set_ring_sync(False)) restores the psum path bit-for-bit; tuple axis
+    specs and non-tiling widths fall back to psum inside ring_all_reduce."""
+    from .ring_collective import ring_all_reduce, ring_sync_enabled
+
+    if ring_sync_enabled() and isinstance(k_spec, str):
+        return ring_all_reduce(y, k_spec, mesh.shape[k_spec])
+    return jax.lax.psum(y, k_spec)
 
 
 _q40_mm = custom_partitioning(_q40_mm_impl, static_argnums=(3, 4))

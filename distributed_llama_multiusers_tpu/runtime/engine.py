@@ -97,6 +97,13 @@ class EngineStats:
     # decode program's post-SPMD HLO — the Sent/Recv kB analogue on a mesh
     sync_bytes_per_decode: int = 0
     sync_collectives_per_decode: int = 0
+    # cumulative estimated collective payload (bytes/chip) dispatched with
+    # decode-FAMILY steps (sync/multi/spec/pipelined/fused), i.e.
+    # sync_bytes_per_decode accrued per chained step — feeds /stats and the
+    # dllama_sync_bytes_total counter on /metrics. Prefill-only dispatches
+    # are not counted (their program's traffic differs from the decode
+    # estimate); 0 off-mesh or before collective_stats() runs.
+    sync_bytes_total: int = 0
     # writers (engine hot paths, scheduler counters) hold this around their
     # multi-field bumps; snapshot()/reset() hold it while copying, so a
     # /stats read sees one consistent point in time instead of field-by-field
@@ -122,6 +129,7 @@ class EngineStats:
             "pipeline_depth_hist",
             "fused_steps", "admission_stall_s", "fused_bucket_hist",
             "sync_bytes_per_decode", "sync_collectives_per_decode",
+            "sync_bytes_total",
         ),
     }
 
@@ -152,7 +160,9 @@ class EngineStats:
             self.fused_steps = 0
             self.admission_stall_s = 0.0
             self.fused_bucket_hist = {}
-            # sync_* stay: they describe the compiled program, not a window
+            self.sync_bytes_total = 0
+            # per-decode sync_* stay: they describe the compiled program,
+            # not a window
         return snap
 
     def preserved(self):
@@ -245,6 +255,22 @@ class InferenceEngine:
         else:
             replicate = lambda x: x
 
+        if mesh is not None:
+            # mesh-native token plumbing: the on-device carry feeding the
+            # next pipelined dispatch and the packed [2, n(+1)] token
+            # readbacks are EXPLICITLY replicated — a few bytes per step —
+            # so GSPMD can never choose a sharded layout that would splice
+            # a cross-device gather between chained dispatches (the pod
+            # serving path's first-dispatch stall). Logits keep the
+            # replicate_outputs policy above (replicating [n, vocab] f32 is
+            # an all-gather worth paying only when a host must read it).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            _tok_rep = NamedSharding(mesh, PartitionSpec())
+            rep_tokens = lambda x: jax.lax.with_sharding_constraint(x, _tok_rep)
+        else:
+            rep_tokens = lambda x: x
+
         topk = self.device_topk
 
         def _sample_lane(row, temp, topp, seed, pos, greedy):
@@ -297,7 +323,7 @@ class InferenceEngine:
             # is latency-bound — 8 bytes/lane payload)
             return (
                 replicate(step),
-                replicate(jnp.stack([greedy, sampled])),
+                rep_tokens(jnp.stack([greedy, sampled])),
                 cache,
             )
 
@@ -311,7 +337,7 @@ class InferenceEngine:
             _, greedy, sampled, cache = _decode_core(
                 params, cache, tokens, positions, temps, topps, seeds
             )
-            return replicate(jnp.stack([greedy, sampled])), cache
+            return rep_tokens(jnp.stack([greedy, sampled])), cache
 
         @partial(jax.jit, donate_argnums=(1,))
         def _decode_pl(params, cache, tokens, positions, temps, topps, seeds):
@@ -325,8 +351,8 @@ class InferenceEngine:
             )
             nxt = jnp.where(temps == 0.0, greedy, sampled)
             return (
-                replicate(nxt),
-                replicate(jnp.stack([greedy, sampled])),
+                rep_tokens(nxt),
+                rep_tokens(jnp.stack([greedy, sampled])),
                 cache,
             )
 
@@ -379,7 +405,7 @@ class InferenceEngine:
             )
             # ONE [n, K+1] transfer: emitted tokens + emit count
             packed_out = jnp.concatenate([emitted, n_emit[:, None]], axis=1)
-            return replicate(logits[:, 0, :]), replicate(packed_out), cache
+            return replicate(logits[:, 0, :]), rep_tokens(packed_out), cache
 
         self._decode_spec_fn = _decode_spec
 
@@ -432,7 +458,7 @@ class InferenceEngine:
             )
             return (
                 replicate(last),
-                replicate(jnp.stack([greedy, sampled])),
+                rep_tokens(jnp.stack([greedy, sampled])),
                 cache,
             )
 
@@ -485,7 +511,7 @@ class InferenceEngine:
                 ],
                 axis=1,
             )
-            return replicate(nxt), replicate(packed), cache
+            return rep_tokens(nxt), rep_tokens(packed), cache
 
         @partial(jax.jit, donate_argnums=(0,))
         def _copy_lane(cache, src, dst):
@@ -534,7 +560,7 @@ class InferenceEngine:
                 (_, _, cache), chosen = jax.lax.scan(
                     body, (tokens, positions, cache), None, length=h
                 )
-                return replicate(chosen), cache  # chosen [h, n]
+                return rep_tokens(chosen), cache  # chosen [h, n]
 
             return _decode_multi
 
@@ -683,6 +709,7 @@ class InferenceEngine:
             self.stats.host_bytes_in += toks_np.nbytes
             self.stats.decode_s += time.perf_counter() - t0
             self.stats.decode_steps += 1
+            self.stats.sync_bytes_total += self.stats.sync_bytes_per_decode
         return logits, greedy_np, sampled_np
 
     # pod roots broadcast multi-step decodes as OP_DECODE_MULTI packets
@@ -738,6 +765,7 @@ class InferenceEngine:
             self.stats.decode_s += time.perf_counter() - t0
             self.stats.decode_steps += h
             self.stats.multi_dispatches += 1
+            self.stats.sync_bytes_total += h * self.stats.sync_bytes_per_decode
         return chosen_np
 
     # pod roots broadcast pipelined dispatches as OP_DECODE_PIPELINED packets
@@ -804,6 +832,7 @@ class InferenceEngine:
         self._pl_inflight.append((packed, time.perf_counter()))
         with self.stats.lock:
             self.stats.pipeline_dispatches += 1
+            self.stats.sync_bytes_total += self.stats.sync_bytes_per_decode
             d = len(self._pl_inflight)
             self.stats.pipeline_depth_hist[d] = (
                 self.stats.pipeline_depth_hist.get(d, 0) + 1
@@ -917,6 +946,7 @@ class InferenceEngine:
         with self.stats.lock:
             self.stats.pipeline_dispatches += 1
             self.stats.fused_steps += 1
+            self.stats.sync_bytes_total += self.stats.sync_bytes_per_decode
             self.stats.prefill_tokens += len(chunk)
             self.stats.fused_bucket_hist[bucket] = (
                 self.stats.fused_bucket_hist.get(bucket, 0) + 1
@@ -1025,6 +1055,7 @@ class InferenceEngine:
             self.stats.decode_s += time.perf_counter() - t0
             self.stats.decode_steps += 1
             self.stats.spec_steps += 1
+            self.stats.sync_bytes_total += self.stats.sync_bytes_per_decode
         return logits, emitted, n_emit
 
     def sample_token(
@@ -1198,16 +1229,30 @@ def warmup_engine(
     # one structured line deployments verify engine config from logs alone
     # (telemetry/logs.py; the scheduler-side twin is scheduler_start)
     mesh = getattr(engine, "mesh", None)
+    # mesh engines: AOT-compile the decode step NOW (outside preserved(), so
+    # the sync_bytes_per_decode estimate survives into serving) — the first
+    # pod dispatch must not pay the compile, and /stats should report the
+    # per-step collective payload from the start
+    if mesh is not None:
+        coll = getattr(engine, "collective_stats", None)
+        if callable(coll):
+            try:
+                coll()
+            except Exception:  # the probe is evidence, never a startup blocker
+                pass
     pipelined = bool(
         pipeline
         and getattr(engine, "supports_pipelined", False)
         and getattr(engine, "pipeline_depth", 0) > 1
     )
+    from ..ops.ring_collective import ring_sync_enabled
+
     log_event(
         "warmup_engine",
         n_lanes=n,
         buckets_warmed=list(engine.prefill_buckets),
         mesh_shape=dict(mesh.shape) if mesh is not None else None,
+        ring_sync=bool(mesh is not None and ring_sync_enabled()),
         pipeline_depth=getattr(engine, "pipeline_depth", 0),
         pipelined=pipelined,
         # fused admissions need the live pipeline (and were only warmed

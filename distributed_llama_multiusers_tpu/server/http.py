@@ -165,7 +165,14 @@ class ApiServer:
                 round(stats["spec_emitted"] / stats["spec_lane_steps"], 3)
                 if stats["spec_lane_steps"] else None
             ),
+            # per-step collective traffic (mesh runs; 0 single-chip): the
+            # static per-decode estimate, the collective count behind it,
+            # and the cumulative payload accrued per decode-family
+            # dispatch — the /metrics dllama_sync_bytes_total counter is
+            # delta-fed from the same field (telemetry/hub.bridge_stats)
             "sync_bytes_per_decode": stats["sync_bytes_per_decode"],
+            "sync_collectives_per_decode": stats["sync_collectives_per_decode"],
+            "sync_bytes_total": stats["sync_bytes_total"],
             # multi-step horizons taken (each = several decode steps in one
             # device dispatch; decode_steps counts the chained steps)
             "multi_dispatches": stats["multi_dispatches"],

@@ -84,6 +84,23 @@ class Telemetry:
             "overlap_s / (overlap_s + decode_s): fraction of engine decode "
             "wall-time the async pipeline hid behind device execution",
         )
+        # pod-serving sync cost next to TTFT/TBT: the estimated collective
+        # payload accrued per decode-family dispatch (reconciles with the
+        # /stats sync_bytes_total field the bridge republishes — same
+        # source, delta-fed below) and the MEASURED per-step collective
+        # time from profiler probes (engine.measured_sync_stats)
+        self.sync_bytes = reg.counter(
+            "dllama_sync_bytes_total",
+            "estimated collective payload bytes (per chip) dispatched with "
+            "decode-family steps, from the compiled program's post-SPMD HLO",
+        )
+        self.sync_seconds = reg.histogram(
+            "dllama_sync_seconds",
+            "measured per-decode-step collective time (profiler probe: "
+            "engine.measured_sync_stats)",
+            LATENCY_BUCKETS_S,
+        )
+        self._sync_bytes_seen = 0
 
     # -- queue binding -------------------------------------------------------
 
@@ -198,6 +215,18 @@ class Telemetry:
                                       fused=True)
         self.step_duration.observe(max(0.0, now_pc - t_dispatch))
 
+    def observe_sync_probe(self, breakdown: dict, steps: int = 1) -> None:
+        """Feed a measured per-step sync split (the dict from
+        ``engine.measured_sync_stats`` / ``measured_step_breakdown``) into
+        the ``dllama_sync_seconds`` histogram — one observation per
+        measured step, so the histogram count reads as probed steps. No-op
+        when the probe had no collective data (off-mesh, wall-only)."""
+        ms = breakdown.get("sync_ms")
+        if ms is None:
+            return
+        for _ in range(max(1, int(steps))):
+            self.sync_seconds.observe(ms / 1e3)
+
     def on_flush(self, live: int, admitting: int) -> None:
         self.tracer.instant("pipeline.flush", "pipeline",
                             args={"live": live, "admitting": admitting})
@@ -276,6 +305,16 @@ class Telemetry:
         decode = float(stats.get("decode_s") or 0.0)
         if overlap + decode > 0:
             self.overlap_fraction.set(overlap / (overlap + decode))
+        # the native sync-bytes counter tracks the same accounting the
+        # dllama_stats_sync_bytes_total gauge republishes, delta-fed so it
+        # keeps Prometheus counter semantics across engine.stats.reset()
+        # windows (the gauge resets with /stats; the counter never goes back)
+        total = stats.get("sync_bytes_total")
+        if isinstance(total, (int, float)):
+            if total > self._sync_bytes_seen:
+                self.sync_bytes.inc(float(total - self._sync_bytes_seen))
+            # a drop means the stats window reset: re-baseline, counter keeps
+            self._sync_bytes_seen = float(total)
 
     def render_prometheus(self, bridge: dict | None = None) -> str:
         if bridge:

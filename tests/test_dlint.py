@@ -443,6 +443,41 @@ def test_pipeline_sync_covers_fused_dispatch(tmp_path):
     assert "pipeline-sync" not in checks_of(clean)
 
 
+def test_pipeline_sync_mesh_native_dispatch(tmp_path):
+    """The mesh-native dispatch path (pod serving): sharding constraints
+    on the device token carry are pure trace-time annotations — no
+    finding — but reading the carry back to pick a shard (the tempting
+    'just check the carry is replicated' bug) re-serializes the chain on
+    every chip and IS one."""
+    clean = run_on(tmp_path, {"runtime/engine.py": """
+        import jax
+        import numpy as np
+
+        class E:
+            def decode_pipelined(self, positions, tokens=None):
+                feed = self._pl_carry if tokens is None else tokens
+                feed = jax.lax.with_sharding_constraint(feed, self._tok_rep)
+                nxt, packed, self.cache = self._decode_pl_fn(feed, positions)
+                self._pl_carry = nxt
+                self._pl_inflight.append(packed)
+    """})
+    assert "pipeline-sync" not in checks_of(clean)
+    bad = run_on(tmp_path / "bad", {"runtime/engine.py": """
+        import numpy as np
+
+        class E:
+            def decode_pipelined(self, positions, tokens=None):
+                feed = self._pl_carry if tokens is None else tokens
+                # 'verify' the carry landed replicated: a full device sync
+                carry_host = np.asarray(feed)
+                nxt, packed, self.cache = self._decode_pl_fn(
+                    carry_host, positions
+                )
+                self._pl_carry = nxt
+    """})
+    assert "pipeline-sync" in checks_of(bad)
+
+
 def test_pipeline_sync_waiver_suppresses(tmp_path):
     """A waiver naming BOTH overlapping checks silences the line (host-sync
     also scopes these files)."""
@@ -632,6 +667,70 @@ def test_sharding_axis_default_axes_without_decl(tmp_path):
         BAD = P("nope")
     """})
     assert checks_of(findings) == ["sharding-axis"]
+
+
+def test_sharding_axis_covers_ring_collectives(tmp_path):
+    """The ring-collective entry points (ops/ring_collective.py) take the
+    mesh axis name as a plain argument like the lax primitives they wrap —
+    a misspelled axis there must be a lint finding, not a trace-time error
+    on a real pod. Known-bad: bogus axes through every ring call shape;
+    known-good: the declared axes pass clean."""
+    findings = run_on(tmp_path, {
+        "parallel/mesh.py": 'AXES = ("dp", "tp")\n',
+        "ops/ring_collective.py": """
+            import jax
+
+            def sync(x, w, mesh, n):
+                a = ring_reduce_scatter(x, "ring", n)
+                b = ring_all_gather(a, "tp", n)
+                c = ring_all_reduce(x, "tpx", n)
+                d = ring_sync_matmul(x, w, mesh, axis="modell")
+                return b, c, d
+        """,
+    })
+    assert checks_of(findings) == ["sharding-axis"] * 3
+    msgs = " ".join(f.message for f in findings)
+    assert "'ring'" in msgs and "'tpx'" in msgs and "'modell'" in msgs
+    clean = run_on(tmp_path / "clean", {
+        "parallel/mesh.py": 'AXES = ("dp", "tp")\n',
+        "ops/ring_collective.py": """
+            import jax
+
+            def sync(x, w, mesh, n):
+                a = ring_reduce_scatter(x, "tp", n)
+                b = ring_all_gather_q80(a, "tp", n)
+                r = jax.lax.axis_index("tp")
+                return ring_sync_matmul(x, w, mesh, axis="tp"), b, r
+        """,
+    })
+    assert "sharding-axis" not in checks_of(clean)
+
+
+def test_real_ring_collective_axis_sites_are_covered():
+    """Rot-guard: the shipped ring_collective module really contains the
+    call shapes the checker knows (ring calls with a positional or axis=
+    axis name), so the vocabulary cannot silently drift from the code."""
+    import ast
+
+    from distributed_llama_multiusers_tpu.analysis.sharding_check import (
+        COLLECTIVE_CALLS,
+    )
+
+    src = (
+        PACKAGE_ROOT / "ops" / "ring_collective.py"
+    ).read_text(encoding="utf-8")
+    tree = ast.parse(src)
+    called = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", None)
+            if name in COLLECTIVE_CALLS:
+                called.add(name)
+    # the module itself exercises the ring vocabulary plus the lax
+    # primitives underneath it
+    assert {"ppermute", "axis_index"} <= called
+    assert {"ring_reduce_scatter", "ring_all_gather"} & called
 
 
 # -- lock-order (dlint v2 cross-file concurrency layer) ----------------------

@@ -192,12 +192,7 @@ def test_quantized_params_shard_and_forward_on_mesh():
     )
 
 
-def test_q80_sync_matmul_parity_and_payload_drop():
-    """--buffer-float-type q80 on a tp mesh ships the wo/w2 sync as int8+
-    scales (parallel/collectives.q80_sync_matmul) — outputs stay within Q80
-    tolerance of the f32-sync forward and the compiled program's collective
-    payload drops (the reference's ZQ-pipe bandwidth claim, ~4x on the
-    gather half; src/llm.cpp:150, SURVEY.md §5.8)."""
+def _q80_sync_fixture():
     import jax
     from distributed_llama_multiusers_tpu.models import (
         init_kv_cache,
@@ -206,7 +201,6 @@ def test_q80_sync_matmul_parity_and_payload_drop():
     )
     from distributed_llama_multiusers_tpu.models.config import LlamaConfig
     from distributed_llama_multiusers_tpu.parallel import MeshPlan, make_mesh
-    from distributed_llama_multiusers_tpu.parallel.comm_stats import collective_stats_of
     from distributed_llama_multiusers_tpu.parallel.sharding import shard_params
 
     config = LlamaConfig(
@@ -226,23 +220,83 @@ def test_q80_sync_matmul_parity_and_payload_drop():
         )
 
     cache = init_kv_cache(config, 2)
-    ref, _ = fwd(False)(params, tokens, positions, cache)
-    got, _ = fwd(True)(params, tokens, positions, cache)
-    # Q80 rounding noise only (int8 blocks, f16 scales)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.15, rtol=0.05)
-    assert not np.allclose(np.asarray(got), np.asarray(ref)), (
-        "q80 path produced bit-identical logits — quantized sync not active?"
-    )
+    return fwd, params, tokens, positions, cache
 
-    base = collective_stats_of(fwd(False), params, tokens, positions, cache)
-    q80 = collective_stats_of(fwd(True), params, tokens, positions, cache)
-    # the parser counts OUTPUT payload per op, which flatters all-reduce
-    # (a ring all-reduce moves ~2x its payload on the wire, the rs+ag pair
-    # exactly 1x each): f32 all-reduce 1.0 vs rs 0.5 + int8 ag ~0.27 = 0.77
-    # measured here; on the wire the drop is ~(2.0 -> 0.77), ~2.6x
-    assert q80["total_bytes"] < 0.8 * base["total_bytes"], (base, q80)
-    # the int8 gather must be visible in the mix
-    assert any(k.startswith("all-gather") for k in q80["bytes_by_kind"]), q80
+
+def test_q80_sync_matmul_parity_and_payload_drop():
+    """--buffer-float-type q80 on a tp mesh ships the wo/w2 sync as int8+
+    scales — outputs stay within Q80 tolerance of the f32-sync forward and
+    the compiled program's collective payload drops (the reference's
+    ZQ-pipe bandwidth claim, ~4x on the gather half; src/llm.cpp:150,
+    SURVEY.md §5.8). This test pins the LEGACY psum_scatter+all_gather
+    transport (parallel/collectives.q80_sync_matmul), which since PR 7 is
+    the --ring-sync off escape-hatch lowering — the default routes the
+    same wire format through the ring (companion test below)."""
+    from distributed_llama_multiusers_tpu.ops.ring_collective import (
+        ring_sync_enabled,
+        set_ring_sync,
+    )
+    from distributed_llama_multiusers_tpu.parallel.comm_stats import collective_stats_of
+
+    prev = ring_sync_enabled()
+    try:
+        set_ring_sync(False)
+        fwd, params, tokens, positions, cache = _q80_sync_fixture()
+        ref, _ = fwd(False)(params, tokens, positions, cache)
+        got, _ = fwd(True)(params, tokens, positions, cache)
+        # Q80 rounding noise only (int8 blocks, f16 scales)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.15, rtol=0.05)
+        assert not np.allclose(np.asarray(got), np.asarray(ref)), (
+            "q80 path produced bit-identical logits — quantized sync not active?"
+        )
+
+        base = collective_stats_of(fwd(False), params, tokens, positions, cache)
+        q80 = collective_stats_of(fwd(True), params, tokens, positions, cache)
+        # the parser counts OUTPUT payload per op, which flatters all-reduce
+        # (a ring all-reduce moves ~2x its payload on the wire, the rs+ag pair
+        # exactly 1x each): f32 all-reduce 1.0 vs rs 0.5 + int8 ag ~0.27 = 0.77
+        # measured here; on the wire the drop is ~(2.0 -> 0.77), ~2.6x
+        assert q80["total_bytes"] < 0.8 * base["total_bytes"], (base, q80)
+        # the int8 gather must be visible in the mix
+        assert any(k.startswith("all-gather") for k in q80["bytes_by_kind"]), q80
+    finally:
+        set_ring_sync(prev)
+
+
+def test_q80_sync_over_ring_parity_and_hlo_shape():
+    """The PR-7 default: on a pure-TP mesh the q80 wire rides the RING
+    (ops/ring_collective.ring_sync_matmul q80_wire) — same Q80 tolerance
+    class vs the f32-sync forward, and the compiled program's collectives
+    are chunk-sized collective-permutes (the overlappable hops), not one
+    monolithic all-reduce, with int8 permutes visibly shrinking the
+    payload vs the f32-wire ring."""
+    from distributed_llama_multiusers_tpu.ops.ring_collective import (
+        ring_sync_enabled,
+        set_ring_sync,
+    )
+    from distributed_llama_multiusers_tpu.parallel.comm_stats import collective_stats_of
+
+    prev = ring_sync_enabled()
+    try:
+        set_ring_sync(True)
+        fwd, params, tokens, positions, cache = _q80_sync_fixture()
+        ref, _ = fwd(False)(params, tokens, positions, cache)
+        got, _ = fwd(True)(params, tokens, positions, cache)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.15, rtol=0.05)
+        assert not np.allclose(np.asarray(got), np.asarray(ref)), (
+            "q80 wire produced bit-identical logits — quantized sync not active?"
+        )
+
+        base = collective_stats_of(fwd(False), params, tokens, positions, cache)
+        q80 = collective_stats_of(fwd(True), params, tokens, positions, cache)
+        # ring lowering: hops only — no all-reduce/all-gather ops remain
+        for stats in (base, q80):
+            assert set(stats["bytes_by_kind"]) == {"collective-permute"}, stats
+        # int8 wire on the gather hops: strictly fewer payload bytes than
+        # the f32 wire (scales ride too, so the drop is < 4x, but real)
+        assert q80["total_bytes"] < base["total_bytes"], (base, q80)
+    finally:
+        set_ring_sync(prev)
 
 
 def test_pad_packed_d_out_caps_overhead():

@@ -580,3 +580,53 @@ def test_error_payloads_carry_request_id(mock_server):
         assert err["request_id"] > 0
     finally:
         sched.tokenizer = StubStreamTokenizer(sched.engine.config.vocab_size)
+
+
+def test_sync_bytes_bridge_is_delta_fed_across_resets():
+    """The PR-7 sync bridge (telemetry/hub.bridge_stats): the native
+    ``dllama_sync_bytes_total`` counter tracks the /stats
+    ``sync_bytes_total`` field by DELTAS, so it keeps Prometheus counter
+    semantics across engine.stats.reset() windows — the bridged gauge
+    resets with /stats, the counter never goes backwards."""
+    tel = Telemetry(logger=JsonLogger(stream=io.StringIO()))
+
+    def counter_value():
+        m = re.search(
+            r"^dllama_sync_bytes_total (\S+)$",
+            tel.registry.render(), re.M,
+        )
+        return float(m.group(1)) if m else 0.0
+
+    tel.bridge_stats({"sync_bytes_total": 1000})
+    assert counter_value() == 1000
+    tel.bridge_stats({"sync_bytes_total": 1000})  # unchanged window
+    assert counter_value() == 1000
+    tel.bridge_stats({"sync_bytes_total": 1500})
+    assert counter_value() == 1500
+    # stats window reset: the gauge drops to 0, the counter must NOT
+    tel.bridge_stats({"sync_bytes_total": 0})
+    assert counter_value() == 1500
+    # accrual resumes from the new baseline
+    tel.bridge_stats({"sync_bytes_total": 300})
+    assert counter_value() == 1800
+    # and the verbatim gauge tracks the raw field (endpoint reconciliation)
+    m = re.search(
+        r"^dllama_stats_sync_bytes_total (\S+)$", tel.registry.render(), re.M
+    )
+    assert float(m.group(1)) == 300
+
+
+def test_observe_sync_probe_feeds_histogram():
+    """``observe_sync_probe`` turns a measured_step_breakdown dict into one
+    dllama_sync_seconds observation per probed step; wall-only breakdowns
+    (no collective data, e.g. off-mesh) observe nothing."""
+    tel = Telemetry(logger=JsonLogger(stream=io.StringIO()))
+    tel.observe_sync_probe({"step_ms": 5.0, "sync_ms": None}, steps=4)
+    assert tel.sync_seconds.count == 0
+    tel.observe_sync_probe({"step_ms": 5.0}, steps=4)  # key absent entirely
+    assert tel.sync_seconds.count == 0
+    tel.observe_sync_probe({"step_ms": 5.0, "sync_ms": 2.0}, steps=4)
+    assert tel.sync_seconds.count == 4
+    # the observed value is seconds (2 ms each)
+    q = tel.sync_seconds.quantile(0.5)
+    assert q is not None and 5e-4 < q < 5e-3
