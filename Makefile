@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint lockgraph sanitize dryrun clean
+.PHONY: all native test verify lint lockgraph sanitize dryrun chaos clean
 
 all: native
 
@@ -52,6 +52,17 @@ lint:
 # serving-dispatch changes — it is the CPU stand-in for a real pod.
 dryrun:
 	python scripts/dryrun_multichip.py
+
+# Chaos gate (docs/SERVING.md "Failure containment & chaos testing"):
+# the deterministic fault-injection suite — engine faults contained
+# mid-churn with unaffected streams byte-identical, breaker
+# closed→open→half-open→closed over /health+/stats, watchdog firing on
+# a blackholed consume, fault-plan determinism, control-packet
+# integrity, and the HTTP bounded-wait 503. Mock-engine based: runs in
+# seconds, no accelerator. Run it before shipping scheduler/serving/
+# control-plane changes; the same tests ride tier-1 via `verify`.
+chaos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_failures.py -q
 
 # Reviewer aid for new lock/broadcast code (ROADMAP items 2-4): the
 # statically computed lock-order DAG, DOT on stdout (waived edges

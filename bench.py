@@ -26,6 +26,10 @@ single phase can eat the budget:
                token), mesh-native pipelined+fused dispatch, ring-
                overlapped activation sync; reports tok/s/chip against
                the 200 north star plus the measured sync-ms split
+  serving_faults — the chaos gate: churn with a deterministic engine
+               fault injected mid-run (DLLAMA_FAULTS, utils/faults.py);
+               reports error rate, hang-free, and breaker recovery time
+               — the failure-containment layer's evidence
   ablations  — packed Q40 via XLA dequant, dense bf16 (what the kernel buys)
   8b         — the BASELINE north star: Llama-3.1-8B Q40 decode tok/s vs
                200 tok/s/chip (BASELINE.md), now on by default
@@ -901,6 +905,139 @@ def _phase_pod_serving(config, small):
     }
 
 
+def _phase_serving_faults(config, small):
+    """Chaos gate as a bench phase (failure containment, ISSUE 8): the
+    churn arrival process with a DETERMINISTIC engine fault injected
+    mid-run (utils/faults.py; `DLLAMA_FAULTS` overrides the default
+    one-shot dispatch fault). Reports what the containment layer is FOR:
+
+    - error rate — how many requests the one engine fault actually cost
+      (only the lanes occupied at the failure instant, finish_reason
+      "error", request_id-carrying failures);
+    - hang-free — every submitted future RESOLVED (the pre-containment
+      failure mode was a dead loop thread with every client blocked);
+    - recovery — the circuit breaker re-closed after the fault
+      (`serving_faults_recovery_ms` = how long the circuit held open),
+      and the loop kept serving: requests after the fault completed
+      normally with the ring drained."""
+    from concurrent.futures import TimeoutError as FuturesTimeout
+
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.runtime.engine import warmup_engine
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+    from distributed_llama_multiusers_tpu.serving import (
+        AdmissionRejected,
+        CircuitBreaker,
+    )
+    from distributed_llama_multiusers_tpu.telemetry import Telemetry
+    from distributed_llama_multiusers_tpu.utils import faults
+
+    n_lanes = 2 if small else 4
+    n_requests = 10 if small else 24
+    max_tokens = 8 if small else 24
+    params = _resident_packed_params(config)
+    engine = InferenceEngine(
+        config, params, n_lanes=n_lanes, prefill_buckets=(16,)
+    )
+    telemetry = Telemetry()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=0.5)
+    # threshold 1: the single default fault also walks the breaker through
+    # open -> (cooldown) -> recovery, so the phase banks a recovery time
+    sched = ContinuousBatchingScheduler(
+        engine, _BenchTokenizer(config.vocab_size), speculative=False,
+        telemetry=telemetry, breaker=breaker,
+    )
+    # compile OUTSIDE the armed window: warmup dispatches must not
+    # advance the fault plan's arrival counters
+    warmup_engine(engine, spec=False, multi_step=sched.multi_step)
+    spec = os.environ.get("DLLAMA_FAULTS", "engine.dispatch:@20:n=1")
+    plan = faults.arm(spec)
+
+    rng = np.random.default_rng(11)
+    intervals = rng.exponential(0.05, n_requests)
+    reqs = [
+        Request(
+            prompt="chaos benchmark prompt " * 2,
+            max_tokens=max_tokens,
+            temperature=0.0 if i % 2 == 0 else 0.8,
+            seed=300 + i,
+        )
+        for i in range(n_requests)
+    ]
+    submitted, shed = [], 0
+    hang_free = True
+    sched.start()
+    t0 = time.perf_counter()
+    try:
+        for r, dt in zip(reqs, intervals):
+            time.sleep(dt)
+            try:
+                sched.submit(r)
+                submitted.append(r)
+            except AdmissionRejected:
+                shed += 1  # open circuit mid-churn: shed is correct behavior
+        for r in submitted:
+            try:
+                r.future.result(timeout=300)
+            except FuturesTimeout:
+                hang_free = False  # THE failure containment exists to prevent
+                r.cancel()
+            except Exception:  # noqa: BLE001 — failed requests are the point
+                pass
+        # recovery: if the circuit is still open (fault landed late), give
+        # it a cooldown and drive one probe request through
+        probes = 0
+        deadline = time.monotonic() + 10
+        while breaker.state != "closed" and time.monotonic() < deadline:
+            time.sleep(0.55)
+            probe = Request(prompt="probe", max_tokens=2, temperature=0.0)
+            try:
+                sched.submit(probe)
+                probes += 1
+                probe.future.result(timeout=60)
+            except Exception:  # noqa: BLE001 — the state read below decides
+                pass
+        wall = time.perf_counter() - t0
+    finally:
+        faults.disarm()
+        sched.stop()
+
+    outcomes: dict[str, int] = {}
+    for r in submitted:
+        outcomes[str(r.finish_reason)] = outcomes.get(str(r.finish_reason), 0) + 1
+    n_err = outcomes.get("error", 0)
+    br = breaker.stats()
+    qos = sched.qos_stats()
+    rec_ms = (
+        None if br["breaker_last_recovery_s"] is None
+        else round(br["breaker_last_recovery_s"] * 1e3, 1)
+    )
+    return {
+        "serving_faults_spec": spec,
+        "serving_faults_fired": len(plan.fired_log()),
+        "serving_faults_requests": n_requests,
+        "serving_faults_submitted": len(submitted),
+        "serving_faults_shed": shed,
+        "serving_faults_errors": n_err,
+        "serving_faults_error_rate": round(n_err / max(1, len(submitted)), 4),
+        "serving_faults_finish_reasons": outcomes,
+        # the three headline properties of the chaos gate:
+        "serving_faults_hang_free": hang_free,
+        "serving_faults_recovered": breaker.state == "closed",
+        "serving_faults_recovery_ms": rec_ms,
+        "serving_faults_probes": probes,
+        "serving_faults_engine_failure_rounds": qos["engine_failure_rounds"],
+        "serving_faults_breaker_trips": br["breaker_trips"],
+        "serving_faults_ring_drained": engine.pipeline_inflight() == 0,
+        "serving_faults_wall_s": round(wall, 2),
+    }
+
+
 def _pipeline_microbench(n_requests=4, max_tokens=48):
     """Drive the REAL scheduler loop over the mocked async engine
     (utils.testing.MockAsyncEngine — the same stub the pinned tests in
@@ -1165,6 +1302,8 @@ def child_main() -> None:
         result = _phase_serving_churn(config, small)
     elif phase == "pod_serving":
         result = _phase_pod_serving(config, small)
+    elif phase == "serving_faults":
+        result = _phase_serving_faults(config, small)
     elif phase == "ablations":
         result = _phase_ablations(config, small)
     elif phase == "8b":
@@ -1322,6 +1461,7 @@ def main() -> None:
     # every phase after it (round 5) — order so a wedge costs nothing.
     for phase, cap in (
         ("serving", 420.0), ("serving_churn", 300.0), ("pod_serving", 300.0),
+        ("serving_faults", 240.0),
         ("8b", 500.0), ("ablations", 420.0), ("longctx", 300.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)

@@ -49,6 +49,11 @@ SCOPE = (
     # registered file-by-file because scope matching is suffix-based
     "telemetry/__init__.py", "telemetry/hub.py", "telemetry/spans.py",
     "telemetry/metrics.py", "telemetry/trace.py", "telemetry/logs.py",
+    # failure containment rides the serving loop too: the breaker is fed
+    # from every engine step, the watchdog brackets every blocking call,
+    # and the fault hooks sit inside the dispatch paths — none of them
+    # may ever touch a device value
+    "serving/breaker.py", "serving/watchdog.py", "utils/faults.py",
 )
 CAST_SCOPE = ("runtime/engine.py",)
 

@@ -134,6 +134,16 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "on (DLLAMA_RING_SYNC env equivalent); 'off' "
                         "restores the plain psum sync bit-for-bit "
                         "(escape hatch)")
+    p.add_argument("--step-deadline", type=float, default=None,
+                   help="serving: failure-containment watchdog — if a "
+                        "dispatched engine step makes no progress for "
+                        "this many seconds, trip the circuit breaker and "
+                        "abort the async chain (single host) or crash "
+                        "the process deliberately (pods, where "
+                        "jax.distributed peer-failure detection turns "
+                        "death into a pod-wide signal while a silent "
+                        "hang wedges everything). Default: "
+                        "DLLAMA_STEP_DEADLINE env, else off (0)")
     # observability (telemetry/, docs/OBSERVABILITY.md)
     p.add_argument("--trace-path", default=None,
                    help="serving: write the request-lifecycle span ring as "

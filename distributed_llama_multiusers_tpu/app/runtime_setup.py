@@ -232,6 +232,17 @@ def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
     fp = getattr(args, "fused_prefill", None)
     if fp is not None:  # --fused-prefill on/off (stall-free admissions)
         overrides["fused_prefill"] = fp == "on"
+    # failure containment (serving/breaker.py, serving/watchdog.py): the
+    # step watchdog arms when --step-deadline / DLLAMA_STEP_DEADLINE is
+    # set; on a pod ROOT a trip crashes the process deliberately so
+    # jax.distributed peer-failure detection surfaces the hang (the
+    # multihost.py analysis: death beats silent desync)
+    sd = getattr(args, "step_deadline", None)
+    if sd is not None:
+        overrides["step_deadline_s"] = sd
+    overrides["watchdog_fatal"] = (
+        getattr(engine, "_plane", None) is not None  # RootControlEngine
+    )
     # QoS surface (--max-queue / --queue-timeout / --request-budget):
     # bounded admission with per-user fair share, plus deadlines
     max_queue = getattr(args, "max_queue", 0) or 0

@@ -36,6 +36,20 @@ import os
 
 import numpy as np
 
+from ..telemetry.logs import log_event
+from ..utils import faults
+
+# Control-packet integrity word (failure containment satellite): every
+# packet leads with a magic constant and the protocol version, validated
+# on recv BEFORE the op dispatch. A torn packet (a worker joining
+# mid-stream, a collective delivering garbage after a peer death) or a
+# version-skewed peer (rolling restart mixing binaries) becomes a
+# CLASSIFIED ReplayError naming what mismatched — not an "unknown control
+# op N" crash deep in the replay switch that burns a supervised restart
+# on a packet that was never valid.
+PACKET_MAGIC = 0x444C4C41  # "DLLA"
+PROTOCOL_VERSION = 1
+
 OP_STOP = 0
 OP_PREFILL = 1
 OP_DECODE = 2
@@ -52,6 +66,14 @@ OP_DECODE_PREFILL_FUSED = 9  # stall-free admission: ONE dispatch that both
 # advances the pipelined decode lanes and consumes a bounded prompt chunk
 # for one admitting lane — bucket + chunk header ride the packet so every
 # process compiles/replays the identical per-bucket fused program
+
+
+class ReplayError(RuntimeError):
+    """Classified control-plane replay failure: the packet itself is bad
+    (magic/version mismatch, unknown op) — detected BEFORE any engine
+    dispatch, so no collective was entered and the pod cannot have
+    desynced on it. ``worker_serve`` counts these separately from engine
+    replay errors and does not burn its restart budget on them."""
 
 
 def maybe_initialize_distributed(args=None) -> int:
@@ -87,8 +109,11 @@ def maybe_initialize_distributed(args=None) -> int:
 class ControlPlane:
     """Fixed-size int32 packet, broadcast from process 0 each engine call.
 
-    Layout: [op, lane, n, start_pos, payload_a[L] .. payload_e[L]] with
-    L = max(n_lanes, chunk). PREFILL: payload_a[:n] = prompt-chunk tokens,
+    Layout: [magic, version, op, lane, n, start_pos,
+    payload_a[L] .. payload_e[L]] with L = max(n_lanes, chunk). The
+    leading magic + protocol-version words are validated on ``recv``
+    (see PACKET_MAGIC above): a torn or version-skewed packet raises a
+    classified :class:`ReplayError` before the op switch ever runs. PREFILL: payload_a[:n] = prompt-chunk tokens,
     payload_b/c[0] = temperature/top-p float32 bit patterns, payload_d[0] =
     sampler seed (first-token sampling is fused into the compiled prefill,
     so its scalar operands must be byte-identical on every process).
@@ -117,7 +142,7 @@ class ControlPlane:
     programs, and every process must dispatch the same one.
     """
 
-    HEADER = 4
+    HEADER = 6  # [magic, version, op, lane, n, start_pos]
     SLOTS = 7
 
     def __init__(self, n_lanes: int, chunk: int = 1024):
@@ -139,8 +164,9 @@ class ControlPlane:
         return pkt[start : start + n]
 
     def _send(self, op: int, lane: int, n: int, start_pos: int, *payloads) -> None:
+        faults.fire("plane.broadcast")  # chaos harness; no-op unarmed
         pkt = np.zeros(self._size, np.int32)
-        pkt[0:4] = (op, lane, n, start_pos)
+        pkt[0:6] = (PACKET_MAGIC, PROTOCOL_VERSION, op, lane, n, start_pos)
         for i, payload in enumerate(payloads):
             if payload is not None:
                 start = self.HEADER + i * self.chunk
@@ -261,7 +287,34 @@ class ControlPlane:
         self._send(OP_COPY_LANE, src, 0, dst)
 
     def recv(self) -> np.ndarray:
-        return self._bcast(np.zeros(self._size, np.int32))
+        faults.fire("plane.recv")  # chaos harness; no-op unarmed
+        pkt = self._bcast(np.zeros(self._size, np.int32))
+        self.validate(pkt)
+        return pkt
+
+    @staticmethod
+    def validate(pkt: np.ndarray) -> None:
+        """Packet integrity gate, run on every recv BEFORE the op switch:
+        a torn packet or a version-skewed root becomes a classified
+        :class:`ReplayError` (pre-dispatch — no collective was entered on
+        it), not an "unknown control op" crash burning a restart."""
+        if len(pkt) < ControlPlane.HEADER:
+            raise ReplayError(
+                f"control packet truncated: {len(pkt)} words < header "
+                f"{ControlPlane.HEADER}"
+            )
+        if int(pkt[0]) != PACKET_MAGIC:
+            raise ReplayError(
+                f"control packet magic mismatch: got 0x{int(pkt[0]) & 0xFFFFFFFF:08X}, "
+                f"want 0x{PACKET_MAGIC:08X} (torn packet, or a peer that is "
+                "not a dllama control plane)"
+            )
+        if int(pkt[1]) != PROTOCOL_VERSION:
+            raise ReplayError(
+                f"control packet protocol version {int(pkt[1])} != "
+                f"{PROTOCOL_VERSION}: root and worker binaries are skewed "
+                "(finish the rolling restart before serving)"
+            )
 
 
 class RootControlEngine:
@@ -425,6 +478,20 @@ class RootControlEngine:
         self._plane.send_pipeline_flush()
         return self._engine.pipeline_flush()
 
+    def pipeline_abort(self) -> int:
+        """Containment on a pod root (scheduler `_contain_engine_failure`):
+        the workers must drop their rings and carries too, or they stay
+        permanently diverged from the root's freshly aborted chain and
+        every later pipelined packet fails their pre-dispatch validation
+        — burning supervised restarts until the pod dies. The flush
+        packet is the op workers already honor (their drain is their own
+        harmless readback); the root side then aborts WITHOUT consuming
+        (its readbacks would re-raise the failure being contained).
+        Without this override, __getattr__ would forward to the inner
+        engine and abort the root ring silently."""
+        self._plane.send_pipeline_flush()
+        return self._engine.pipeline_abort()
+
     def decode_spec(
         self, tokens, drafts, draft_len, positions,
         temps=None, topps=None, seeds=None,
@@ -489,7 +556,9 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
     packet — ``worker_serve`` uses it to refresh its restart budget."""
     while True:
         pkt = plane.recv()
-        op, lane, n, start_pos = (int(x) for x in pkt[:4])
+        # header: [magic, version, op, lane, n, start_pos] — magic/version
+        # already validated by plane.recv()
+        op, lane, n, start_pos = (int(x) for x in pkt[2:6])
         if op == OP_STOP:
             return
         if op == OP_PREFILL:
@@ -584,13 +653,15 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
         elif op == OP_COPY_LANE:
             engine.copy_lane(lane, start_pos)  # src, dst ride the header
         else:
-            raise ValueError(f"unknown control op {op}")
+            # classified, pre-dispatch (no engine call was made for this
+            # packet): worker_serve resubscribes without burning a restart
+            raise ReplayError(f"unknown control op {op}")
         if on_replay is not None:
             on_replay()
 
 
 def worker_serve(engine, plane: ControlPlane, max_restarts: int | None = 3,
-                 healthy_window: int = 64, log=print) -> None:
+                 healthy_window: int = 64, log=None) -> None:
     """Supervised worker: re-enter ``worker_loop`` after a replay error — the
     analogue of runWorkerApp's outer loop, which catches exceptions and
     re-``serve()``s instead of exiting (src/app.cpp:455-463). A worker that
@@ -610,24 +681,71 @@ def worker_serve(engine, plane: ControlPlane, max_restarts: int | None = 3,
     resets, so a long-lived worker absorbing an occasional transient error
     re-serves indefinitely like the reference's outer loop — while a
     persistent error (or a tight burst, the desync signature) still
-    exhausts the budget within one window and raises."""
+    exhausts the budget within one window and raises.
+
+    Classified :class:`ReplayError`\\ s (packet magic/version mismatch,
+    unknown op — raised BEFORE any engine dispatch, so no collective was
+    entered) do not burn the restart budget; they have their own, much
+    larger storm bound. Every restart emits a structured JSON log event
+    (telemetry/logs.py — ``worker_restart`` / ``worker_protocol_error``,
+    greppable and pipeline-parsable like the root's request lines) and
+    bumps ``engine.stats.worker_restarts`` / ``worker_replay_errors`` so
+    worker health is a /stats read, not a stderr grep. ``log`` (optional
+    callable) additionally receives a one-line human summary — the CLI
+    passes its emoji logger."""
     restarts = 0
     healthy = 0
+    protocol_errors = 0
+    # a packet storm (every recv invalid) must still crash out eventually;
+    # scale with the restart budget, never below a generous floor
+    protocol_budget = max(64, (max_restarts or 0) * 16)
+    stats = getattr(engine, "stats", None)
+
+    def _count(field: str) -> None:
+        if stats is not None:
+            with stats.lock:
+                setattr(stats, field, getattr(stats, field) + 1)
 
     def _replayed() -> None:
-        nonlocal restarts, healthy
+        nonlocal restarts, healthy, protocol_errors
         healthy += 1
         if healthy >= healthy_window:
             restarts = 0
+            protocol_errors = 0
             healthy = 0
 
     while True:
         try:
             worker_loop(engine, plane, on_replay=_replayed)
             return
+        except ReplayError as e:
+            # pre-dispatch protocol failure: no engine call was made for
+            # the bad packet, so no desync is possible — resubscribe
+            # without burning the restart budget
+            healthy = 0
+            protocol_errors += 1
+            _count("worker_replay_errors")
+            log_event(
+                "worker_protocol_error",
+                error=str(e)[:200],
+                protocol_errors=protocol_errors,
+                protocol_budget=protocol_budget,
+            )
+            if log is not None:
+                log(f"worker protocol error ({protocol_errors}): {e}")
+            if protocol_errors > protocol_budget:
+                raise
         except Exception as e:  # noqa: BLE001 — supervised restart boundary
             healthy = 0
             restarts += 1
-            log(f"worker replay error (restart {restarts}): {e!r}")
+            _count("worker_restarts")
+            log_event(
+                "worker_restart",
+                error=f"{type(e).__name__}: {e}"[:200],
+                restarts=restarts,
+                max_restarts=max_restarts,
+            )
+            if log is not None:
+                log(f"worker replay error (restart {restarts}): {e!r}")
             if max_restarts is not None and restarts > max_restarts:
                 raise

@@ -34,6 +34,7 @@ from ..lockcheck import make_lock
 from ..models.config import LlamaConfig
 from ..models.llama import KVCache, LlamaParams, init_kv_cache, llama_forward
 from ..telemetry.logs import log_event
+from ..utils import faults
 from .spec import SPEC_DRAFT
 
 DEFAULT_PREFILL_BUCKETS = (16, 64, 256, 1024)
@@ -104,6 +105,11 @@ class EngineStats:
     # are not counted (their program's traffic differs from the decode
     # estimate); 0 off-mesh or before collective_stats() runs.
     sync_bytes_total: int = 0
+    # failure containment (multihost.worker_serve): supervised-restart and
+    # classified replay-protocol-error counts on THIS process, so pod
+    # worker health is a stats read, not a stderr grep
+    worker_restarts: int = 0
+    worker_replay_errors: int = 0
     # writers (engine hot paths, scheduler counters) hold this around their
     # multi-field bumps; snapshot()/reset() hold it while copying, so a
     # /stats read sees one consistent point in time instead of field-by-field
@@ -129,7 +135,7 @@ class EngineStats:
             "pipeline_depth_hist",
             "fused_steps", "admission_stall_s", "fused_bucket_hist",
             "sync_bytes_per_decode", "sync_collectives_per_decode",
-            "sync_bytes_total",
+            "sync_bytes_total", "worker_restarts", "worker_replay_errors",
         ),
     }
 
@@ -161,6 +167,7 @@ class EngineStats:
             self.admission_stall_s = 0.0
             self.fused_bucket_hist = {}
             self.sync_bytes_total = 0
+            self.worker_restarts = self.worker_replay_errors = 0
             # per-decode sync_* stay: they describe the compiled program,
             # not a window
         return snap
@@ -610,6 +617,7 @@ class InferenceEngine:
                 f"chunk of {len(chunk)} tokens at pos {start_pos} exceeds "
                 f"seq_len {self.config.seq_len}"
             )
+        faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
         t0 = time.perf_counter()
         bucket = self.bucket_for(len(chunk))
         padded = np.zeros(bucket, np.int32)
@@ -686,6 +694,7 @@ class InferenceEngine:
             topps = np.full(n, DEFAULT_TOPP, np.float32)
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
+        faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
         t0 = time.perf_counter()
         operands = (
             self.params,
@@ -748,6 +757,7 @@ class InferenceEngine:
         fn = self._decode_multi_fns.get(h)
         if fn is None:
             fn = self._decode_multi_fns[h] = self._make_decode_multi(h)
+        faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
         t0 = time.perf_counter()
         chosen, self.cache = fn(
             self.params,
@@ -815,6 +825,7 @@ class InferenceEngine:
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
         self.check_pipelined_dispatch(tokens is not None)
+        faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
         if tokens is None:
             feed = self._pl_carry
         else:
@@ -918,6 +929,7 @@ class InferenceEngine:
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
         self.check_fused_dispatch(chunk, p_start, tokens is not None)
+        faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
         if tokens is None:
             feed = self._pl_carry
         else:
@@ -967,6 +979,7 @@ class InferenceEngine:
         sampled[i] otherwise (the on-device feed rule)."""
         if not self._pl_inflight:
             raise RuntimeError("pipeline ring empty: nothing to consume")
+        faults.fire("engine.consume")  # chaos harness; no-op unarmed
         packed, dispatched_at = self._pl_inflight.popleft()
         t0 = time.perf_counter()
         # dlint: ok[host-sync] the lagged ONE [2, n] int32 readback per pipelined step (greedy+sampled rows), counted below
@@ -996,6 +1009,26 @@ class InferenceEngine:
             self.pipeline_consume()
         self._pl_carry = None
         if n and count:
+            with self.stats.lock:
+                self.stats.pipeline_flushes += 1
+        return n
+
+    def pipeline_abort(self) -> int:
+        """Containment primitive (the supervised scheduler loop's engine-
+        failure path): drop every in-flight step WITHOUT reading anything
+        back, and drop the carry. ``pipeline_flush`` drains through
+        ``pipeline_consume`` — but after an engine-scoped failure each
+        readback of a poisoned step would re-raise the same error, so
+        containment must be able to abandon the ring host-side. The
+        device buffers are released with the dropped references; the next
+        chain reseeds from host tokens like any post-flush dispatch, and
+        the affected lanes' KV is treated as garbage (the scheduler
+        discards their resident-KV maps). Counts as a pipeline flush —
+        an aborted chain is the definition of one."""
+        n = len(self._pl_inflight)
+        self._pl_inflight.clear()
+        self._pl_carry = None
+        if n:
             with self.stats.lock:
                 self.stats.pipeline_flushes += 1
         return n
@@ -1035,6 +1068,7 @@ class InferenceEngine:
             topps = np.full(n, DEFAULT_TOPP, np.float32)
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
+        faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
         t0 = time.perf_counter()
         logits, packed_out, self.cache = self._decode_spec_fn(
             self.params,
@@ -1131,6 +1165,7 @@ class InferenceEngine:
 
     def lane_logits(self, logits, lane: int) -> np.ndarray:
         """Transfer one lane's logits to host (counted, for sampling)."""
+        faults.fire("engine.transfer")  # chaos harness; no-op unarmed
         # dlint: ok[host-sync] sanctioned [vocab] f32 transfer API: the choke point that counts the bytes
         out = np.asarray(logits[lane])
         with self.stats.lock:
@@ -1139,6 +1174,7 @@ class InferenceEngine:
 
     def all_logits(self, logits) -> np.ndarray:
         """Single batched device->host transfer of all lanes' logits."""
+        faults.fire("engine.transfer")  # chaos harness; no-op unarmed
         # dlint: ok[host-sync] sanctioned batched [n, vocab] f32 transfer API: the choke point that counts the bytes
         out = np.asarray(logits)
         with self.stats.lock:

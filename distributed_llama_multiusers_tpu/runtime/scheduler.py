@@ -38,18 +38,48 @@ import numpy as np
 
 from ..serving import (
     AdmissionRejected,
+    CircuitBreaker,
     DeadlinePolicy,
     Priority,
     QosQueue,
+    StepWatchdog,
     budget_expired,
     drain_scheduler,
     queue_expired,
 )
+from ..serving.watchdog import deadline_from_env
 from ..telemetry import Telemetry
 from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerChatStops
+from ..utils import faults
 from ..utils.seeds import fresh_seed
 from .engine import DEFAULT_TOPP
 from .spec import NgramDraftIndex
+
+
+class EngineFailure(RuntimeError):
+    """Engine-scoped serving failure, resolved onto a request's future by
+    the containment layer. Carries the ``request_id`` so the HTTP 500
+    body / terminal SSE error chunk can name it — the future's exception
+    is all the transport layer sees."""
+
+    def __init__(self, message: str, request_id: int | None = None):
+        self.request_id = request_id
+        super().__init__(message)
+
+
+def classify_failure(e: BaseException) -> str:
+    """Failure containment classification (the supervised loop's rule):
+
+    - ``"request"`` — per-request input errors: tokenization, empty
+      prompts, per-lane validation. The ``ValueError`` family by
+      convention (every engine-side argument check raises it). Fails
+      only that request (``finish_reason="error"``); the engine is fine.
+    - ``"engine"`` — everything an engine dispatch/consume/transfer can
+      raise (XLA ``RESOURCE_EXHAUSTED``, transfer errors, injected
+      faults): the pipeline flushes, affected lanes fail, lane state
+      resets, and the loop keeps serving behind the circuit breaker.
+    """
+    return "request" if isinstance(e, ValueError) else "engine"
 
 
 class RequestState(Enum):
@@ -199,6 +229,9 @@ class ContinuousBatchingScheduler:
         pipelined: bool = True,
         fused_prefill: bool = True,
         telemetry: Telemetry | None = None,
+        breaker: CircuitBreaker | None = None,
+        step_deadline_s: float | None = None,
+        watchdog_fatal: bool = False,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
@@ -272,7 +305,22 @@ class ContinuousBatchingScheduler:
         at ``GET /metrics`` / ``GET /trace``; the bench reports its
         percentiles. Span stamping never happens inside the pipelined
         dispatch half (dlint ``pipeline-sync`` pins that): pipelined step
-        slices are recorded by the consume half, one step behind."""
+        slices are recorded by the consume half, one step behind.
+
+        ``breaker`` (serving/breaker.py): the circuit breaker the
+        supervised loop feeds — N consecutive engine-scoped failures flip
+        ``/health`` unhealthy and ``submit()`` sheds with 503 +
+        Retry-After until a half-open probe succeeds. Always present
+        (a default is built when the caller passes none).
+
+        ``step_deadline_s`` (serving/watchdog.py): when > 0, a watchdog
+        thread trips if a blocking engine step (sync decode, prefill
+        chunk, lagged pipeline consume) makes no progress within the
+        deadline — tripping the breaker and aborting the chain
+        single-host, crashing the process deliberately on a pod
+        (``watchdog_fatal=True``) so ``jax.distributed`` peer-failure
+        detection surfaces the hang. ``None`` reads
+        ``DLLAMA_STEP_DEADLINE``; 0 disables."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.queue = queue_ or QosQueue()
@@ -297,6 +345,25 @@ class ContinuousBatchingScheduler:
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._thread: threading.Thread | None = None
+        # failure containment (serving/breaker.py, serving/watchdog.py):
+        # the supervised loop's admission gate + stall detector
+        self.breaker = breaker or CircuitBreaker()
+        deadline = deadline_from_env(step_deadline_s)
+        self.watchdog = (
+            StepWatchdog(
+                deadline, on_trip=self._on_watchdog_trip,
+                fatal=watchdog_fatal,
+            )
+            if deadline > 0
+            else None
+        )
+        # watchdog -> loop signal: abort the pipelined chain at the next
+        # host-side opportunity (a slow-but-alive step returns eventually;
+        # the chain must not keep extending behind it)
+        self._wd_abort = threading.Event()
+        # engine-scoped containment rounds (loop thread writes, /stats
+        # reads; single GIL-atomic int bump like the timeout counters)
+        self.engine_failures = 0
         self._chat_stops = TokenizerChatStops(tokenizer)
         self._prefill_rr = 0  # round-robin cursor over admitting lanes
         # deadline enforcement counters (loop thread writes, /stats reads;
@@ -310,6 +377,12 @@ class ContinuousBatchingScheduler:
     def start(self) -> None:
         self._stop.clear()  # restartable: a stop()ed scheduler can start again
         self._draining.clear()
+        self._wd_abort.clear()
+        # chaos harness: DLLAMA_FAULTS arms the process-global fault plan
+        # (utils/faults.py) — one env read, idempotent, no-op otherwise
+        faults.maybe_arm_from_env()
+        if self.watchdog is not None:
+            self.watchdog.start()
         self._thread = threading.Thread(target=self._run, name="batching-loop", daemon=True)
         self._thread.start()
         # one structured line deployments verify serving config from
@@ -328,6 +401,11 @@ class ContinuousBatchingScheduler:
             queue_capacity=getattr(self.queue, "capacity", None),
             queue_timeout_s=self.deadlines.queue_timeout_s,
             request_budget_s=self.deadlines.request_budget_s,
+            breaker_threshold=self.breaker.threshold,
+            step_deadline_s=(
+                self.watchdog.deadline_s if self.watchdog is not None else 0
+            ),
+            faults_armed=faults.armed(),
         )
 
     def stop(self) -> None:
@@ -346,6 +424,8 @@ class ContinuousBatchingScheduler:
                     "lanes — not dropping the reference"
                 )
             self._thread = None
+        if self.watchdog is not None:
+            self.watchdog.stop()
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful shutdown (serving/drain.py): stop admitting — submit()
@@ -362,6 +442,17 @@ class ContinuousBatchingScheduler:
     def submit(self, request: Request) -> Request:
         if self._draining.is_set():
             self._shed_draining()
+        if not self.breaker.allow():
+            # engine unhealthy (open circuit): shed BEFORE the queue so a
+            # broken engine degrades into fast 503s + Retry-After instead
+            # of a backlog of clients waiting on an engine that cannot
+            # serve them. Half-open probes pass through here.
+            note = getattr(self.queue, "note_rejection", None)
+            if note is not None:
+                note("breaker_open")
+            raise AdmissionRejected(
+                "breaker_open", retry_after_s=self.breaker.retry_after_s()
+            )
         if request.submitted_at is None:
             request.submitted_at = time.monotonic()
         # attach the lifecycle record BEFORE the push: the loop thread may
@@ -408,11 +499,34 @@ class ContinuousBatchingScheduler:
             "draining": self.draining,
             "queue_timeouts": self.queue_timeouts,
             "budget_timeouts": self.budget_timeouts,
+            # failure containment: engine-scoped containment rounds, the
+            # breaker state machine, and the watchdog (0 trips when off)
+            "engine_failure_rounds": self.engine_failures,
         }
+        out.update(self.breaker.stats())
+        if self.watchdog is not None:
+            out.update(self.watchdog.stats())
         stats = getattr(self.queue, "stats", None)
         if callable(stats):
             out.update(stats())
         return out
+
+    def _on_watchdog_trip(self, waited_s: float) -> None:
+        """Watchdog callback (runs on the watchdog thread): a dispatched
+        step made no progress within the deadline. Trip the breaker —
+        /health flips unhealthy and new work sheds — and flag the
+        pipelined chain to abort at its next host-side opportunity (a
+        slow-but-alive step eventually returns; the chain must not keep
+        extending behind it). On pods the watchdog itself then crashes
+        the process (fatal=True) — deliberate death over silent desync."""
+        self.breaker.trip(
+            f"watchdog: no step progress within {waited_s:.1f}s"
+        )
+        self._wd_abort.set()
+        self.telemetry.on_watchdog_trip(
+            waited_s,
+            fatal=self.watchdog.fatal if self.watchdog is not None else False,
+        )
 
     def _resolve_unadmitted(self, req: Request, reason: str) -> None:
         """Finish a request that never claimed a lane (queue timeout, cancel
@@ -434,6 +548,32 @@ class ContinuousBatchingScheduler:
         self.telemetry.on_unadmitted(req, "shed")
         if not req.future.done():
             req.future.set_exception(AdmissionRejected("draining", retry_after_s=5.0))
+
+    def _fail_request(self, lane_idx: int, req: Request, error: str,
+                      exc: BaseException | None = None) -> None:
+        """Fail ONE request with ``finish_reason="error"`` and reclaim its
+        lane: the request-scoped containment unit (also the per-lane body
+        of engine-scoped containment). The lane's resident-KV map is
+        DISCARDED — after a failed dispatch the cache contents are
+        unknown, and prefix caching must never reuse garbage. The
+        future's exception carries the request_id (EngineFailure) unless
+        the original exception is more specific (a tokenizer ValueError
+        maps to a 400, not a 500)."""
+        req.state = RequestState.FAILED
+        req.error = error
+        req.finish_reason = "error"
+        self._lanes[lane_idx] = _Lane()
+        self._lane_kv[lane_idx] = []
+        try:
+            self.engine.reset_lane(lane_idx)
+        except Exception:  # noqa: BLE001 — containment must not throw
+            pass
+        self.telemetry.on_error(req, lane_idx, error)
+        if not req.future.done():
+            req.future.set_exception(
+                exc if exc is not None
+                else EngineFailure(error, request_id=req.id)
+            )
 
     def _sweep_queue(self, now: float) -> None:
         """Resolve queued requests that expired or were cancelled while
@@ -488,13 +628,16 @@ class ContinuousBatchingScheduler:
         self.telemetry.on_admit(req, lane_idx)
         try:
             self._start_request(lane_idx, req)
-        except Exception as e:  # tokenization errors fail the request
-            req.state = RequestState.FAILED
-            req.error = str(e)
-            self._lanes[lane_idx] = _Lane()
-            self.telemetry.on_error(req, lane_idx, str(e))
-            if not req.future.done():
-                req.future.set_exception(e)
+        except Exception as e:
+            # tokenization / validation errors fail ONLY this request
+            # (finish_reason="error", original exception preserved so the
+            # HTTP layer can 400 a ValueError); an engine-scoped raise
+            # (the prefix-cache lane copy is a device op) fails it too,
+            # then propagates to the supervisor for full containment
+            self._fail_request(lane_idx, req, str(e), exc=e)
+            if classify_failure(e) == "engine":
+                raise
+            self.breaker.record_request_failure()
             return -1
         return lane_idx
 
@@ -594,6 +737,9 @@ class ContinuousBatchingScheduler:
         req = lane.request
         chunk = lane.pending[: self.engine.max_chunk()]
         t_chunk = time.perf_counter()
+        wd = self.watchdog
+        if wd is not None:
+            wd.begin_step()
         try:
             logits, greedy, sampled = self.engine.prefill_chunk(
                 lane_idx, chunk, lane.pos,
@@ -601,13 +747,19 @@ class ContinuousBatchingScheduler:
                 topp=req.topp, seed=lane.seed,
             )
         except Exception as e:
-            req.state = RequestState.FAILED
-            req.error = str(e)
-            self._lanes[lane_idx] = _Lane()
-            self.telemetry.on_error(req, lane_idx, str(e))
-            if not req.future.done():
-                req.future.set_exception(e)
+            # request-scoped (chunk validation, the ValueError family):
+            # fail this request only; engine-scoped (a dispatch raise):
+            # propagate to the supervisor, which flushes the pipeline and
+            # fails every affected lane — this one included
+            if classify_failure(e) == "engine":
+                raise
+            self._fail_request(lane_idx, req, str(e), exc=e)
+            self.breaker.record_request_failure()
             return True
+        finally:
+            if wd is not None:
+                wd.step_done()
+        self.breaker.record_success()
         self.telemetry.on_prefill_chunk(req, lane_idx, t_chunk, len(chunk))
         lane.pos += len(chunk)
         lane.pending = lane.pending[len(chunk):]
@@ -629,8 +781,23 @@ class ContinuousBatchingScheduler:
     def _consume(self, lane_idx: int, lane: _Lane, tok: int) -> bool:
         """Emit one generated token on a lane: stream-decode, EOS/stop
         detection, delta callbacks, position advance, length check. Returns
-        False when the lane finished (EOS or length)."""
+        False when the lane finished (EOS or length — or failed: a
+        detokenize/EOS/delta raise is request-scoped, failing only this
+        request while the batch keeps decoding)."""
         req = lane.request
+        try:
+            return self._consume_inner(lane_idx, lane, req, tok)
+        except Exception as e:  # noqa: BLE001 — request-scoped by construction
+            # everything in here is host-side per-request work (stream
+            # decoder, EOS detector, delta callback): a raise of ANY type
+            # says nothing about engine health, so it fails this request
+            # only — no classification needed
+            self._fail_request(lane_idx, req, str(e), exc=e)
+            self.breaker.record_request_failure()
+            return False
+
+    def _consume_inner(self, lane_idx: int, lane: _Lane, req: Request,
+                       tok: int) -> bool:
         req.generated_tokens.append(tok)
         # per-token stamp: first token observes TTFT, later ones the
         # inter-token gap (multi-step/spec bursts land near-zero gaps —
@@ -884,7 +1051,15 @@ class ContinuousBatchingScheduler:
         dispatch stamp: the telemetry slice spans dispatch -> this lagged
         readback, recorded HERE (the consume half) so the dispatch half
         stays span-free (dlint pipeline-sync)."""
-        greedy_np, sampled_np = self.engine.pipeline_consume()
+        wd = self.watchdog
+        if wd is not None:
+            wd.begin_step()
+        try:
+            greedy_np, sampled_np = self.engine.pipeline_consume()
+        finally:
+            if wd is not None:
+                wd.step_done()
+        self.breaker.record_success()
         now = time.monotonic()
         step_lanes, fused, t_dispatch = entry
         self.telemetry.on_pipelined_step(t_dispatch, fused)
@@ -995,7 +1170,11 @@ class ContinuousBatchingScheduler:
                 else:
                     self.budget_timeouts += 1
                     self._finish(i, lane.request, reason="timeout")
-            flush = self._stop.is_set() or (not live and not admitting)
+            flush = (
+                self._stop.is_set()
+                or self._wd_abort.is_set()  # watchdog: abort the chain
+                or (not live and not admitting)
+            )
             if not flush and fused:
                 # a claimed lane whose chunks cannot ride the chain (a
                 # host-exact admission): only the synchronous path can
@@ -1070,9 +1249,104 @@ class ContinuousBatchingScheduler:
             req.future.set_result(req.generated_text)
 
     def _run(self) -> None:
+        """Supervised outer loop (failure containment, the ISSUE 8
+        tentpole — the analogue of the reference's supervised serve loop,
+        src/app.cpp:455-463, on the ROOT side): the serving loop body
+        runs inside a containment boundary, so an engine exception
+        escaping a dispatch/consume/transfer can no longer kill the
+        daemon batching thread and leave every future unresolved with
+        /health still green. Engine-scoped failures are contained
+        (`_contain_engine_failure`: abort the pipeline ring, fail the
+        affected lanes with finish_reason="error", reset lane state, feed
+        the circuit breaker) and the loop KEEPS SERVING — shedding at
+        admission while the breaker is open, probing half-open, closing
+        on recovery. Request-scoped failures never reach here (their
+        sites fail the one request inline). The `finally` runs the
+        stop()-style future cleanup even on a truly-fatal path (a raise
+        out of containment itself), so no client ever hangs on a dead
+        loop."""
+        try:
+            while True:
+                try:
+                    self._serve_loop()
+                    break  # clean exit: stop() or drain complete
+                except Exception as e:  # noqa: BLE001 — containment boundary
+                    self._contain_engine_failure(e)
+                    if self._stop.is_set():
+                        break
+        finally:
+            self._resolve_exit()
+
+    def _contain_engine_failure(self, e: BaseException) -> None:
+        """Engine-scoped containment: log + count the failure, abort the
+        pipeline ring WITHOUT consuming (each readback of a poisoned
+        in-flight step would re-raise), fail every occupied lane with
+        ``finish_reason="error"`` (their KV is garbage now — the
+        resident-KV maps are discarded so prefix caching can never reuse
+        it), and leave the lanes fresh for the next admission. Never
+        raises: containment is the one layer that must not fail."""
+        err = f"{type(e).__name__}: {e}"
+        self.engine_failures += 1
+        state = self.breaker.record_engine_failure(err)
+        busy = [
+            (i, l.request)
+            for i, l in enumerate(self._lanes)
+            if l.request is not None
+        ]
+        self.telemetry.on_engine_failure(
+            err, lanes_failed=len(busy), breaker_state=state
+        )
+        try:
+            abort = getattr(self.engine, "pipeline_abort", None)
+            if abort is not None:
+                abort()
+            elif getattr(self.engine, "pipeline_active", False):
+                # fallback for engines without the abort primitive; no
+                # count= kwarg — proxies (RootControlEngine) don't take it,
+                # and an aborted chain SHOULD count as a flush anyway
+                self.engine.pipeline_flush()
+        except Exception:  # noqa: BLE001 — containment must not throw
+            pass
+        for i, req in busy:
+            try:
+                self._fail_request(i, req, err)
+            except Exception:  # noqa: BLE001 — containment must not throw
+                pass
+
+    def _resolve_exit(self) -> None:
+        """The stop()/drain() future cleanup, in a ``finally`` so it runs
+        even when the supervised loop dies fatally: every in-flight lane
+        resolves as cancelled and every queued future resolves (shed on a
+        graceful drain, failed otherwise) — no client hangs on a dead
+        loop thread."""
+        for i, lane in enumerate(self._lanes):
+            if lane.request is not None:
+                self._finish(i, lane.request, reason="cancelled")
+        draining = self._draining.is_set()
+        for req in self.queue.drain():
+            if draining:
+                # graceful drain: a submit() that passed the pre-push shed
+                # check can land its push after this loop's exit snapshot;
+                # shed it like submit() would (503 + Retry-After) —
+                # "scheduler stopped" would surface as a 500 in the middle
+                # of a rolling restart
+                self._shed_unadmitted(req)
+            else:
+                req.state = RequestState.FAILED
+                self.telemetry.on_error(req, None, "scheduler stopped")
+                if not req.future.done():
+                    req.future.set_exception(RuntimeError("scheduler stopped"))
+
+    def _serve_loop(self) -> None:
         n_lanes = self.engine.n_lanes
         cfg = self.engine.config
         while not self._stop.is_set():
+            if self._wd_abort.is_set():
+                # watchdog tripped but the step eventually returned (slow,
+                # not dead): the chain already aborted; clear the flag so
+                # serving resumes (the breaker stays open until a probe
+                # succeeds)
+                self._wd_abort.clear()
             idle = all(l.request is None for l in self._lanes)
             # when every lane is free, park on the queue's condition variable
             # instead of spinning pop(timeout=0)+sleep — an idle server burns
@@ -1222,37 +1496,49 @@ class ContinuousBatchingScheduler:
             h = 0 if draft_len is not None else self._multi_horizon(
                 active, prefilled
             )
+            wd = self.watchdog
+            if wd is not None:
+                wd.begin_step()
             t_step = time.perf_counter()
-            if draft_len is not None:
-                logits, emitted, n_emit = self.engine.decode_spec(
-                    tokens, drafts, draft_len, positions, temps, topps, seeds
+            try:
+                if draft_len is not None:
+                    logits, emitted, n_emit = self.engine.decode_spec(
+                        tokens, drafts, draft_len, positions, temps, topps,
+                        seeds
+                    )
+                elif h > 1:
+                    logits = None  # host-exact lanes are excluded by the gate
+                    chosen = self.engine.decode_multi(
+                        tokens, positions, temps, topps, seeds, h
+                    )
+                else:
+                    # logits materialize only when a host-exact lane will
+                    # read them: the common all-device-sampling step keeps
+                    # no [n_lanes, vocab] buffer alive
+                    logits, greedy, sampled = self.engine.decode(
+                        tokens, positions, temps, topps, seeds,
+                        want_logits=host_exact_active,
+                    )
+                self.telemetry.on_step(
+                    "spec" if draft_len is not None
+                    else ("multi" if h > 1 else "sync"),
+                    t_step, args={"h": h} if h > 1 else None,
                 )
-            elif h > 1:
-                logits = None  # host-exact lanes are excluded by the gate
-                chosen = self.engine.decode_multi(
-                    tokens, positions, temps, topps, seeds, h
-                )
-            else:
-                # logits materialize only when a host-exact lane will read
-                # them: the common all-device-sampling step keeps no
-                # [n_lanes, vocab] buffer alive
-                logits, greedy, sampled = self.engine.decode(
-                    tokens, positions, temps, topps, seeds,
-                    want_logits=host_exact_active,
-                )
-            self.telemetry.on_step(
-                "spec" if draft_len is not None
-                else ("multi" if h > 1 else "sync"),
-                t_step, args={"h": h} if h > 1 else None,
-            )
-            # host-exact lanes (global host_sampling mode, or per-request
-            # fallback for near-1.0 top-p / very high temperature where the
-            # device sampler's top-k truncation would distort): one batched
-            # [n_lanes, vocab] transfer; pure on-device batches: tokens only
-            logits_np = None
-            if host_exact_active:
-                # dlint: ok[host-sync] host-exact lanes only: ONE batched [n,vocab] f32 transfer, counted by all_logits
-                logits_np = self.engine.all_logits(logits)
+                # host-exact lanes (global host_sampling mode, or
+                # per-request fallback for near-1.0 top-p / very high
+                # temperature where the device sampler's top-k truncation
+                # would distort): one batched [n_lanes, vocab] transfer;
+                # pure on-device batches: tokens only
+                logits_np = None
+                if host_exact_active:
+                    # dlint: ok[host-sync] host-exact lanes only: ONE batched [n,vocab] f32 transfer, counted by all_logits
+                    logits_np = self.engine.all_logits(logits)
+            finally:
+                # disarm on success AND on a raise (a raised step is the
+                # containment layer's business, not a stall)
+                if wd is not None:
+                    wd.step_done()
+            self.breaker.record_success()
 
             for i, lane in active:
                 req = lane.request
@@ -1312,21 +1598,3 @@ class ContinuousBatchingScheduler:
                     lane.next_token = lane.sampler.sample(logits_np[i])
                 else:
                     lane.next_token = nxt_sampled
-        # drain: resolve everything still in flight so no client hangs
-        for i, lane in enumerate(self._lanes):
-            if lane.request is not None:
-                self._finish(i, lane.request, reason="cancelled")
-        draining = self._draining.is_set()
-        for req in self.queue.drain():
-            if draining:
-                # graceful drain: a submit() that passed the pre-push shed
-                # check can land its push after this loop's exit snapshot;
-                # shed it like submit() would (503 + Retry-After) —
-                # "scheduler stopped" would surface as a 500 in the middle
-                # of a rolling restart
-                self._shed_unadmitted(req)
-            else:
-                req.state = RequestState.FAILED
-                self.telemetry.on_error(req, None, "scheduler stopped")
-                if not req.future.done():
-                    req.future.set_exception(RuntimeError("scheduler stopped"))

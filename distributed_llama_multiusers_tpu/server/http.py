@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import queue
+from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..runtime.scheduler import Request
@@ -35,13 +36,38 @@ from ..serving import AdmissionRejected
 from ..tokenizer import ChatItem, TemplateType, chat_generator_for
 from . import api_types
 
+# defense-in-depth bound on how long an HTTP thread waits on the
+# scheduler (seconds). GENEROUS by design: the scheduler's own deadlines
+# (queue timeout, generation budget) and the failure-containment layer
+# resolve futures long before this; the bound only exists so a wedged
+# scheduler — the failure mode the watchdog detects but cannot unblock —
+# can never hang a client socket forever.
+DEFAULT_RESULT_TIMEOUT_S = 600.0
+
+
+class SchedulerStalled(RuntimeError):
+    """A request's future made no progress within the server-side wait
+    bound: the scheduler is wedged (or the request leaked). Mapped to a
+    request_id-carrying 503 + Retry-After — retryable, because a restart
+    or the watchdog will have replaced the engine by then."""
+
+    def __init__(self, request_id: int, waited_s: float):
+        self.request_id = request_id
+        super().__init__(
+            f"no scheduler progress on request {request_id} within "
+            f"{waited_s:.0f}s; the server is unhealthy — retry elsewhere"
+        )
+
 
 class ApiServer:
-    def __init__(self, scheduler, tokenizer, model_name: str = "dllama", template_type: TemplateType = TemplateType.UNKNOWN):
+    def __init__(self, scheduler, tokenizer, model_name: str = "dllama",
+                 template_type: TemplateType = TemplateType.UNKNOWN,
+                 result_timeout_s: float = DEFAULT_RESULT_TIMEOUT_S):
         self.scheduler = scheduler
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.chat_template = chat_generator_for(tokenizer, template_type)
+        self.result_timeout_s = result_timeout_s
         self._httpd: ThreadingHTTPServer | None = None
         self._fallback_tel = None  # see _telemetry()
 
@@ -105,7 +131,18 @@ class ApiServer:
             req.future.add_done_callback(lambda _f: deltas.put(None))
             try:
                 while True:
-                    delta = deltas.get()
+                    try:
+                        # bounded like the non-streaming wait below: the
+                        # gap between deltas is the streaming liveness
+                        # signal, and a wedged scheduler must become a
+                        # terminal error chunk, not a socket held open
+                        # forever
+                        delta = deltas.get(timeout=self.result_timeout_s)
+                    except queue.Empty:
+                        req.cancel()
+                        raise SchedulerStalled(
+                            req.id, self.result_timeout_s
+                        ) from None
                     if delta is None:
                         break
                     send_chunk(chunk_fn(self.model_name, req.id, delta, False))
@@ -132,7 +169,14 @@ class ApiServer:
                 raise
             return {}
 
-        text = req.future.result()
+        try:
+            # satellite (failure containment): a generous bound so a wedged
+            # scheduler can never hang a client socket forever — mapped to
+            # a request_id-carrying 503 by the route handler
+            text = req.future.result(timeout=self.result_timeout_s)
+        except FutureTimeout:
+            req.cancel()  # frees the lane if the loop ever recovers
+            raise SchedulerStalled(req.id, self.result_timeout_s) from None
         return response_fn(
             self.model_name, req.id, text, req.n_prompt_tokens, len(req.generated_tokens),
             req.finish_reason or "stop", summary=req.summary,
@@ -199,6 +243,11 @@ class ApiServer:
             },
             "prefix_hits": stats["prefix_hits"],
             "prefix_tokens_saved": stats["prefix_tokens_saved"],
+            # failure containment (multihost.worker_serve): supervised
+            # restarts + classified protocol errors on THIS process —
+            # non-zero only on pod processes that actually restarted
+            "worker_restarts": stats["worker_restarts"],
+            "worker_replay_errors": stats["worker_replay_errors"],
             "lanes_total": total,
             "lanes_busy": busy,
         }
@@ -299,12 +348,34 @@ class ApiServer:
                     self._json(200, api.handle_trace())
                 elif self.path in ("/", "/health"):
                     # readiness: flips to 503 during drain so load balancers
-                    # stop routing here while in-flight work finishes
+                    # stop routing here while in-flight work finishes — and
+                    # while the engine circuit breaker is open/half-open
+                    # (serving/breaker.py: repeated engine failures or a
+                    # watchdog-detected stall), so a failing replica stops
+                    # taking traffic instead of collecting hung clients
+                    breaker = getattr(api.scheduler, "breaker", None)
+                    br_state = (
+                        breaker.state if breaker is not None else "closed"
+                    )
                     if bool(getattr(api.scheduler, "draining", False)):
                         self._json(
                             503,
                             {"status": "draining", "model": api.model_name},
                             headers={"Retry-After": "5"},
+                        )
+                    elif br_state != "closed":
+                        self._json(
+                            503,
+                            {
+                                "status": "unhealthy",
+                                "breaker": br_state,
+                                "model": api.model_name,
+                            },
+                            headers={
+                                "Retry-After": str(
+                                    max(1, round(breaker.retry_after_s()))
+                                )
+                            },
                         )
                     else:
                         self._json(200, {"status": "ok", "model": api.model_name})
@@ -382,6 +453,15 @@ class ApiServer:
                         self._json(200, handle_fn(body, prepared=prepared))
                 except AdmissionRejected as e:  # shed before any headers
                     self._reject(e)
+                except SchedulerStalled as e:
+                    # wedged scheduler: retryable 503 naming the request
+                    # (streamed variants surface as terminal SSE error
+                    # chunks through the generic handler above — their
+                    # headers are already out)
+                    self._json(
+                        503, err({"error": str(e), "reason": "stalled"}),
+                        headers={"Retry-After": "30"},
+                    )
                 except ValueError as e:
                     self._json(400, err({"error": str(e)}))
                 except Exception as e:  # generation failure

@@ -3,10 +3,14 @@
 The substrate between the HTTP layer (server/http.py) and the
 continuous-batching loop (runtime/scheduler.py): qos.py owns who gets in
 and in what order, deadlines.py owns how long anything may wait or run,
-drain.py owns how the whole thing shuts down without dropping clients.
-Imports nothing from runtime/ or server/ — it is a leaf both depend on.
+drain.py owns how the whole thing shuts down without dropping clients,
+breaker.py owns when a failing engine stops admitting at all, and
+watchdog.py owns turning a hung step into a signal instead of a silent
+wedge. Imports nothing from runtime/ or server/ — it is a leaf both
+depend on.
 """
 
+from .breaker import CircuitBreaker
 from .deadlines import (
     DeadlinePolicy,
     budget_expired,
@@ -16,3 +20,4 @@ from .deadlines import (
 )
 from .drain import drain_scheduler
 from .qos import AdmissionRejected, Priority, QosQueue
+from .watchdog import StepWatchdog

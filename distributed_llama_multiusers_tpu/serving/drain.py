@@ -29,6 +29,7 @@ def drain_scheduler(scheduler, timeout: float | None = None) -> bool:
     drain (all work finished), False when ``timeout`` forced cancellation.
     Idempotent; safe on a scheduler that never started."""
     scheduler._draining.set()
+    watchdog = getattr(scheduler, "watchdog", None)
     thread = scheduler._thread
     if thread is None or not thread.is_alive():
         # loop never ran (or already stopped): nothing is generating, but
@@ -37,6 +38,8 @@ def drain_scheduler(scheduler, timeout: float | None = None) -> bool:
         for req in scheduler.queue.drain():
             scheduler._shed_unadmitted(req)
         scheduler._thread = None
+        if watchdog is not None:
+            watchdog.stop()
         return True
     thread.join(timeout)
     if thread.is_alive():
@@ -62,4 +65,6 @@ def drain_scheduler(scheduler, timeout: float | None = None) -> bool:
     # every future resolves
     for req in scheduler.queue.drain():
         scheduler._shed_unadmitted(req)
+    if watchdog is not None:  # the monitor thread drains with the loop
+        watchdog.stop()
     return True

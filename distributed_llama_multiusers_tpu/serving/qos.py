@@ -71,8 +71,10 @@ class Priority(IntEnum):
 class AdmissionRejected(RuntimeError):
     """Typed load-shed signal: the request never entered the queue.
 
-    ``reason`` is ``"queue_full"`` (bounded admission, HTTP 429) or
-    ``"draining"`` (graceful shutdown in progress, HTTP 503); both carry a
+    ``reason`` is ``"queue_full"`` (bounded admission, HTTP 429),
+    ``"draining"`` (graceful shutdown in progress, HTTP 503), or
+    ``"breaker_open"`` (the engine circuit breaker is shedding while the
+    engine is unhealthy, HTTP 503 — serving/breaker.py); all carry a
     ``retry_after_s`` hint for the ``Retry-After`` header."""
 
     def __init__(
@@ -86,9 +88,16 @@ class AdmissionRejected(RuntimeError):
         self.capacity = capacity
         self.queue_depth = queue_depth
         self.retry_after_s = retry_after_s
-        self.http_status = 503 if reason == "draining" else 429
+        self.http_status = (
+            503 if reason in ("draining", "breaker_open") else 429
+        )
         if reason == "draining":
             msg = "server is draining; not admitting new requests"
+        elif reason == "breaker_open":
+            msg = (
+                "engine circuit breaker open (repeated engine failures); "
+                f"retry in ~{retry_after_s:.0f}s"
+            )
         else:
             msg = (
                 f"queue full ({queue_depth}/{capacity} waiting); "
@@ -297,6 +306,7 @@ class QosQueue:
                 "queue_popped": self._popped,
                 "queue_rejected_full": self._rejected.get("queue_full", 0),
                 "queue_rejected_draining": self._rejected.get("draining", 0),
+                "queue_rejected_breaker": self._rejected.get("breaker_open", 0),
                 # admitted = popped + removed + depth always reconciles
                 "queue_removed": self._removed,
                 "queue_wait_s_total": round(self._wait_s_total, 6),

@@ -1,0 +1,179 @@
+"""Step watchdog: a hung device dispatch must become a signal, not a hang.
+
+parallel/multihost.py documents the pod failure mode this exists for: a
+broadcast with no matching compute (or a peer dying mid-collective)
+leaves every process blocked inside a collective with NO timeout —
+"a hang with no timeout, invisible until the pod is dead". Single-host,
+the same shape appears as a device call that never returns: the
+batching-loop thread blocks forever inside ``np.asarray`` on a poisoned
+buffer, every future hangs, and ``/health`` keeps reporting healthy.
+
+The watchdog is a monitor thread fed from the scheduler's blocking
+engine-call sites: ``begin_step()`` right before the host blocks on the
+device (sync decode, prefill chunk, lagged pipeline consume),
+``step_done()`` when the call returns. If an armed step makes no
+progress within ``deadline_s`` the watchdog trips ONCE for that step:
+
+- **single-host** (``fatal=False``): invoke ``on_trip`` — the scheduler
+  trips the circuit breaker (``/health`` flips, new work sheds with 503)
+  and flags the pipelined chain to abort at the next opportunity. The
+  blocked thread itself cannot be unblocked from here; the point is that
+  the OUTSIDE of the process finds out (clients get 503s + the HTTP
+  layer's bounded waits, operators get the log line + metrics) instead
+  of a silent wedge.
+- **pod** (``fatal=True``): after ``on_trip`` and the log line, CRASH
+  the process (``os._exit``). Per multihost.py's own analysis, death
+  beats silent desync: ``jax.distributed``'s peer-failure detection
+  propagates a dead peer to every host, while a silently hung one wedges
+  the whole pod forever.
+
+Off by default: ``deadline_s <= 0`` never constructs one. The CLI
+surface is ``--step-deadline`` / ``DLLAMA_STEP_DEADLINE`` (seconds).
+Monotonic clocks only; no imports from runtime/ or server/ (this is a
+serving-layer leaf like the rest of the package).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..lockcheck import make_lock
+from ..telemetry.logs import log_event
+
+WATCHDOG_EXIT_CODE = 17  # distinctive: "killed by own watchdog, on purpose"
+
+
+class StepWatchdog:
+    """Trips when an armed step shows no progress for ``deadline_s``.
+
+    ``on_trip(waited_s)`` runs on the watchdog thread, OUTSIDE the
+    watchdog lock (it takes the breaker's and telemetry's locks; holding
+    ours across that would put an edge in the lock-order graph for no
+    reason). One trip per armed step: the trip disarms, and only the
+    next ``begin_step()`` re-arms.
+    """
+
+    # dlint guarded-by declaration (analysis/lock_check.py): the arm
+    # stamp and counters move under _lock / its condition (scheduler
+    # thread arms, watchdog thread scans, /stats reads).
+    _dlint_guarded_by = {
+        ("_lock", "_cond"): ("_armed_at", "_running", "_wd_trips"),
+    }
+
+    def __init__(self, deadline_s: float, on_trip=None, fatal: bool = False):
+        if deadline_s <= 0:
+            raise ValueError("watchdog deadline must be positive (use no "
+                             "watchdog at all to disable)")
+        self.deadline_s = float(deadline_s)
+        self.fatal = bool(fatal)
+        self._trip_fn = on_trip
+        self._lock = make_lock("StepWatchdog._lock")
+        self._cond = threading.Condition(self._lock)
+        self._armed_at: float | None = None
+        self._running = False
+        self._wd_trips = 0
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        with self._cond:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._armed_at = None
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            self._thread = None
+
+    # -- scheduler feed ------------------------------------------------------
+
+    def begin_step(self) -> None:
+        """The host is about to block on the device: arm the deadline."""
+        with self._cond:
+            self._armed_at = time.monotonic()
+            self._cond.notify_all()
+
+    def step_done(self) -> None:
+        """The blocking call returned (success OR exception — a raised
+        step is the containment layer's business, not a stall): disarm."""
+        with self._cond:
+            self._armed_at = None
+            self._cond.notify_all()
+
+    # -- exposition ----------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "watchdog_deadline_s": self.deadline_s,
+                "watchdog_trips": self._wd_trips,
+            }
+
+    # -- monitor thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            waited = 0.0
+            with self._cond:
+                while self._running:
+                    t0 = self._armed_at
+                    if t0 is None:
+                        self._cond.wait()
+                        continue
+                    now = time.monotonic()
+                    if now - t0 > self.deadline_s:
+                        # trip: disarm so one stall fires exactly once
+                        waited = now - t0
+                        self._armed_at = None
+                        self._wd_trips += 1
+                        break
+                    self._cond.wait(self.deadline_s - (now - t0) + 0.001)
+                if not self._running:
+                    return
+            # outside the lock: the callback takes breaker/telemetry locks
+            self._fire(waited)
+
+    def _fire(self, waited_s: float) -> None:
+        log_event(
+            "watchdog_trip",
+            waited_s=round(waited_s, 3),
+            deadline_s=self.deadline_s,
+            fatal=self.fatal,
+        )
+        if self._trip_fn is not None:
+            try:
+                self._trip_fn(waited_s)
+            except Exception:  # noqa: BLE001 — the trip must still crash a pod
+                pass
+        if self.fatal:
+            # pod mode: deliberate process death — jax.distributed's
+            # peer-failure detection turns it into a pod-wide signal,
+            # which a silent hang never becomes (multihost.py's analysis)
+            os._exit(WATCHDOG_EXIT_CODE)
+
+
+def deadline_from_env(flag_value: float | None = None) -> float:
+    """Resolve the step deadline: explicit flag wins, then
+    ``DLLAMA_STEP_DEADLINE``, else 0 (off)."""
+    if flag_value is not None:
+        return max(0.0, float(flag_value))
+    env = os.environ.get("DLLAMA_STEP_DEADLINE")
+    if not env:
+        return 0.0
+    try:
+        return max(0.0, float(env))
+    except ValueError:
+        return 0.0

@@ -100,7 +100,25 @@ class Telemetry:
             "engine.measured_sync_stats)",
             LATENCY_BUCKETS_S,
         )
+        # failure containment (serving/breaker.py, runtime/scheduler.py):
+        # the breaker state machine as a gauge and classified failures as
+        # a labelled counter — both reconciled with the /stats twins via
+        # bridge_stats (the state gauge is set from breaker_state_code,
+        # the counter delta-fed from the engine_failures dict, so counter
+        # semantics survive window resets like dllama_sync_bytes_total)
+        self.breaker_state = reg.gauge(
+            "dllama_breaker_state",
+            "serving circuit breaker: 0 closed, 1 half-open, 2 open "
+            "(anything > 0 means /health is reporting unhealthy)",
+        )
+        self.engine_failures = reg.counter(
+            "dllama_engine_failures_total",
+            "classified serving failures by failure_class label: engine "
+            "(dispatch/consume/transfer raise, contained), request "
+            "(per-request input error), watchdog (stalled step)",
+        )
         self._sync_bytes_seen = 0
+        self._failures_seen: dict[str, float] = {}
 
     # -- queue binding -------------------------------------------------------
 
@@ -231,6 +249,40 @@ class Telemetry:
         self.tracer.instant("pipeline.flush", "pipeline",
                             args={"live": live, "admitting": admitting})
 
+    # -- failure containment -------------------------------------------------
+
+    def on_engine_failure(self, error: str, lanes_failed: int,
+                          breaker_state: str) -> None:
+        """One engine-scoped containment round (runtime/scheduler.py's
+        supervised loop): the loop caught an engine raise, failed the
+        affected lanes, and kept serving. One trace instant + one
+        structured log line — the event operators grep for when error-rate
+        alarms fire."""
+        self.tracer.instant(
+            "engine.failure", "pipeline",
+            args={
+                "error": error[:200],
+                "lanes_failed": lanes_failed,
+                "breaker_state": breaker_state,
+            },
+        )
+        self.logger.emit(
+            "engine_failure",
+            error=error[:200],
+            lanes_failed=lanes_failed,
+            breaker_state=breaker_state,
+        )
+
+    def on_watchdog_trip(self, waited_s: float, fatal: bool) -> None:
+        """The step watchdog (serving/watchdog.py) found a dispatched step
+        with no progress past its deadline. The watchdog emits its own
+        log line before any fatal exit; this is the scheduler-side trace
+        instant tying the trip to the pipeline track."""
+        self.tracer.instant(
+            "watchdog.trip", "pipeline",
+            args={"waited_s": round(waited_s, 3), "fatal": fatal},
+        )
+
     # -- request endings -----------------------------------------------------
 
     def _summarize(self, req, reason: str | None,
@@ -315,6 +367,23 @@ class Telemetry:
                 self.sync_bytes.inc(float(total - self._sync_bytes_seen))
             # a drop means the stats window reset: re-baseline, counter keeps
             self._sync_bytes_seen = float(total)
+        # breaker exposition (serving/breaker.py): the state gauge tracks
+        # breaker_state_code verbatim; the classified-failure counter is
+        # delta-fed from the engine_failures dict, same recipe as above
+        code = stats.get("breaker_state_code")
+        if isinstance(code, (int, float)) and not isinstance(code, bool):
+            self.breaker_state.set(float(code))
+        fails = stats.get("engine_failures")
+        if isinstance(fails, dict):
+            for cls, v in fails.items():
+                if not isinstance(v, (int, float)):
+                    continue
+                seen = self._failures_seen.get(cls, 0.0)
+                if v > seen:
+                    self.engine_failures.inc(
+                        float(v - seen), failure_class=str(cls)
+                    )
+                self._failures_seen[cls] = float(v)
 
     def render_prometheus(self, bridge: dict | None = None) -> str:
         if bridge:

@@ -98,7 +98,13 @@ class MockAsyncEngine:
     admissions interleave: the property the fused-prefill churn tests pin.
     Supports the fused prefill+decode dispatch (``decode_prefill_fused``)
     with the real engine's packed-readback contract (an extra boundary
-    column on fused steps)."""
+    column on fused steps).
+
+    Carries the real engine's fault-injection hooks (utils/faults.py:
+    ``engine.dispatch`` / ``engine.consume``) and its ``pipeline_abort``
+    containment primitive, so the chaos suite (tests/test_failures.py)
+    drives the supervised scheduler loop through deterministic failures
+    without accelerator timing noise."""
 
     supports_multi_step = False
     supports_speculative = False
@@ -136,6 +142,9 @@ class MockAsyncEngine:
         return 2 + (int(lane) * 31 + int(pos) * 7) % (self.config.vocab_size - 2)
 
     def prefill_chunk(self, lane, chunk, start_pos, temp=0.0, topp=0.9, seed=0):
+        from . import faults
+
+        faults.fire("engine.dispatch")
         t = self._tok(lane, start_pos + len(chunk) - 1)
         with self.stats.lock:
             self.stats.prefill_tokens += len(chunk)
@@ -151,6 +160,9 @@ class MockAsyncEngine:
 
     def decode(self, tokens, positions, temps=None, topps=None, seeds=None,
                want_logits=True):
+        from . import faults
+
+        faults.fire("engine.dispatch")
         # synchronous fallback (admission iterations): dispatch + block
         now = time.monotonic()
         self._free_at = max(now, self._free_at) + self.step_s
@@ -170,6 +182,9 @@ class MockAsyncEngine:
 
     def decode_pipelined(self, positions, temps=None, topps=None, seeds=None,
                          tokens=None):
+        from . import faults
+
+        faults.fire("engine.dispatch")
         now = time.monotonic()
         self._free_at = max(now, self._free_at) + self.step_s
         s = self._steps
@@ -191,12 +206,15 @@ class MockAsyncEngine:
         both advances the decode lanes and consumes one prompt chunk; the
         packed readback carries the chunk's boundary token in an extra
         column, like the real engine's [2, n+1] pack."""
+        from . import faults
+
         if not chunk:
             raise ValueError("fused prefill needs a non-empty prompt chunk")
         if len(chunk) > self._max_chunk:
             raise ValueError(
                 f"chunk of {len(chunk)} exceeds bucket {self._max_chunk}"
             )
+        faults.fire("engine.dispatch")
         now = time.monotonic()
         self._free_at = max(now, self._free_at) + self.step_s
         s = self._steps
@@ -220,6 +238,9 @@ class MockAsyncEngine:
     def pipeline_consume(self):
         import numpy as np
 
+        from . import faults
+
+        faults.fire("engine.consume")
         ready_at, dispatched_at, s, positions, boundary = self._ring.pop(0)
         t0 = time.monotonic()
         time.sleep(max(0.0, ready_at - t0))
@@ -239,6 +260,17 @@ class MockAsyncEngine:
             self.pipeline_consume()
         self._carry_live = False
         if n and count:
+            with self.stats.lock:
+                self.stats.pipeline_flushes += 1
+        return n
+
+    def pipeline_abort(self):
+        """The real engine's containment primitive: drop the ring without
+        consuming (a poisoned step's readback would re-raise)."""
+        n = len(self._ring)
+        self._ring.clear()
+        self._carry_live = False
+        if n:
             with self.stats.lock:
                 self.stats.pipeline_flushes += 1
         return n

@@ -931,9 +931,31 @@ def test_real_lock_decls_are_collected():
         "QosQueue._lock", "EngineStats.lock", "SpanTracer._trace_lock",
         "JsonLogger._log_lock", "Counter._m_lock", "Gauge._m_lock",
         "Histogram._m_lock", "MetricsRegistry._reg_lock", "native._lock",
+        # failure containment (ISSUE 8): breaker/watchdog/fault-plan state
+        # is lock-guarded and witness-wrapped like every other lock here
+        "CircuitBreaker._lock", "StepWatchdog._lock", "FaultPlan._lock",
     ):
         assert qual in model.decls, f"lock declaration rotted: {qual}"
     assert model.canonical("QosQueue._not_empty") == "QosQueue._lock"
+    # the watchdog condition is a view of its lock, same as the queue's
+    assert model.canonical("StepWatchdog._cond") == "StepWatchdog._lock"
+
+
+def test_host_sync_covers_containment_files(tmp_path):
+    """ISSUE-8 satellite: the failure-containment files ride the serving
+    loop (breaker fed per step, watchdog bracketing every blocking call,
+    fault hooks inside dispatch paths) — a device->host transfer added to
+    any of them is a host-sync finding like in runtime/."""
+    bad = """
+        import numpy as np
+
+        def fire(point, value):
+            return np.asarray(value)
+    """
+    for rel in ("serving/breaker.py", "serving/watchdog.py",
+                "utils/faults.py"):
+        findings = run_on(tmp_path / rel.replace("/", "_"), {rel: bad})
+        assert checks_of(findings) == ["host-sync"], rel
 
 
 # -- lock-blocking ------------------------------------------------------------

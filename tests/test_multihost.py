@@ -337,9 +337,10 @@ def test_two_process_pod_scheduler_sampled_matches_mesh(tmp_path):
 class _ScriptedPlane:
     """In-process stand-in for ControlPlane: serves a scripted packet list
     (no broadcast, no pod) so worker_serve's restart policy is testable in
-    milliseconds."""
+    milliseconds. Packets carry the real magic/version header and go
+    through the real validation gate."""
 
-    HEADER = 4
+    HEADER = 6
 
     def __init__(self, ops, chunk=8):
         self.chunk = chunk
@@ -348,12 +349,22 @@ class _ScriptedPlane:
     def _pkt(self, op):
         import numpy as np
 
+        from distributed_llama_multiusers_tpu.parallel.multihost import (
+            PACKET_MAGIC, PROTOCOL_VERSION,
+        )
+
         pkt = np.zeros(self.HEADER + 7 * self.chunk, np.int32)
-        pkt[0:4] = (op, 0, 2, 0)
+        pkt[0:6] = (PACKET_MAGIC, PROTOCOL_VERSION, op, 0, 2, 0)
         return pkt
 
     def recv(self):
-        return self._pkts.pop(0)
+        from distributed_llama_multiusers_tpu.parallel.multihost import (
+            ControlPlane,
+        )
+
+        pkt = self._pkts.pop(0)
+        ControlPlane.validate(pkt)
+        return pkt
 
     def slot(self, pkt, i, n):
         start = self.HEADER + i * self.chunk

@@ -174,7 +174,8 @@ def test_pod_root_engine_broadcasts_copy_lane():
     root.copy_lane(0, 1)
     assert inner.copied == (0, 1)
     assert len(sent) == 1
-    assert list(sent[0][:4]) == [OP_COPY_LANE, 0, 0, 1]
+    # header: [magic, version, op, lane, n, start_pos]
+    assert list(sent[0][2:6]) == [OP_COPY_LANE, 0, 0, 1]
     root.copy_lane(1, 1)  # no-op: nothing broadcast, nothing dispatched
     assert len(sent) == 1
 
@@ -190,9 +191,10 @@ def test_pod_root_engine_broadcasts_copy_lane():
 
     weng = _WEngine()
     plane = _ScriptedPlane([OP_COPY_LANE, OP_STOP])
-    # _ScriptedPlane packs (op, 0, 2, 0); patch the copy packet's operands
-    plane._pkts[0][1] = 1  # src
-    plane._pkts[0][3] = 0  # dst
+    # _ScriptedPlane packs (magic, version, op, 0, 2, 0); patch the copy
+    # packet's operands (lane=src at header slot 3, start_pos=dst at 5)
+    plane._pkts[0][3] = 1  # src
+    plane._pkts[0][5] = 0  # dst
     worker_loop(weng, plane)
     assert weng.copied == (1, 0)
 

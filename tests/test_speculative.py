@@ -187,7 +187,9 @@ def test_pod_root_engine_broadcasts_spec():
     drafts = np.array([[3, 4, 5], [6, 7, 8]], np.int32)
     dlen = np.array([3, 0], np.int32)
     root.decode_spec(tokens, drafts, dlen, tokens)
-    assert len(sent) == 1 and sent[0][0] == OP_DECODE_SPEC
+    # header: [magic, version, op, ...] — op rides slot 2 since the
+    # packet-integrity words landed
+    assert len(sent) == 1 and sent[0][2] == OP_DECODE_SPEC
     # the worker-side decode reconstructs the drafts from slots 5/6
     assert list(plane.slot(sent[0], 5, 6)) == [3, 4, 5, 6, 7, 8]
     assert list(plane.slot(sent[0], 6, 2)) == [3, 0]
