@@ -658,10 +658,14 @@ def _phase_serving(config, small):
 
 def _run_churn(sched, n_requests, max_tokens, interval_mean=0.05, seed=7):
     """Poisson-arrival churn against a STARTED-then-stopped scheduler:
-    deterministic seeded arrivals, half greedy / half sampled. Returns
-    (total generated tokens, wall seconds). Shared by the single-chip
-    ``serving_churn`` phase and the mesh ``pod_serving`` phase so the two
-    workloads cannot drift apart."""
+    deterministic seeded arrivals, MIXED traffic — half greedy (their
+    generated streams go repetitive on the tiny config, so the n-gram
+    drafter genuinely hits), a quarter regular-nucleus sampled, and a
+    quarter WIDE-nucleus sampled (top_p = 1.0 — the class that used to
+    flush to the host-exact path and now samples on device with the
+    exact full-vocab sampler). Returns (total generated tokens, wall
+    seconds). Shared by the single-chip ``serving_churn`` phase and the
+    mesh ``pod_serving`` phase so the two workloads cannot drift apart."""
     import numpy as np
 
     from distributed_llama_multiusers_tpu.runtime.scheduler import Request
@@ -673,6 +677,7 @@ def _run_churn(sched, n_requests, max_tokens, interval_mean=0.05, seed=7):
             prompt="churn benchmark prompt " * 2,
             max_tokens=max_tokens,
             temperature=0.0 if i % 2 == 0 else 0.8,
+            topp=1.0 if i % 4 == 3 else 0.9,
             seed=200 + i,
         )
         for i in range(n_requests)
@@ -696,17 +701,21 @@ def _phase_serving_churn(config, small):
     """Poisson-arrival churn against the REAL scheduler: requests join a
     live serving loop mid-generation (the regime the fused prefill+decode
     dispatch exists for) instead of arriving all up front like the
-    `serving` phase's batch. Reports aggregate `serving_churn_tok_s`, the
-    pipeline flush count (stall-free admissions keep it ~0 under churn;
-    speculation is off so admission behavior is what's measured), and
-    TTFT/TBT percentiles read from the SAME telemetry histogram registry
-    the server's /metrics serves — bench numbers and scraped metrics
-    cannot drift, because they are the same counts. Also writes the span
-    ring as a Perfetto-loadable Chrome trace artifact (BENCH_TRACE_PATH
-    overrides the tmp-dir default) and reports its fused-step slice count
-    — the visible form of "admissions rode the live chain".
-    CPU-smoke safe: small lane/request counts, deterministic seeded
-    arrivals."""
+    `serving` phase's batch. ZERO-FLUSH configuration: speculation ON
+    (drafts verify inside the pipelined chain) and wide-nucleus sampled
+    lanes in the mix (on-device exact top-p — the old host-exact flush
+    class), so `serving_churn_pipeline_flushes` must read 0: no
+    systematic flush class is left except stop/drain. Reports aggregate
+    `serving_churn_tok_s`, `spec_emitted_per_dispatch` (tokens per
+    drafted-lane verify step, >1 = speculation composing with the
+    chain), and TTFT/TBT percentiles read from the SAME telemetry
+    histogram registry the server's /metrics serves — bench numbers and
+    scraped metrics cannot drift, because they are the same counts. Also
+    writes the span ring as a Perfetto-loadable Chrome trace artifact
+    (BENCH_TRACE_PATH overrides the tmp-dir default) and reports its
+    fused/spec slice counts — the visible form of "admissions and
+    speculation rode the live chain". CPU-smoke safe: small lane/request
+    counts, deterministic seeded arrivals."""
     import numpy as np
 
     from distributed_llama_multiusers_tpu.runtime import InferenceEngine
@@ -726,12 +735,16 @@ def _phase_serving_churn(config, small):
     )
     tokenizer = _BenchTokenizer(config.vocab_size)
     telemetry = Telemetry()
+    # speculation ON: drafts verify INSIDE the pipelined chain (the
+    # zero-flush tentpole) — the phase measures admission AND speculation
+    # composing, not one at a time
     sched = ContinuousBatchingScheduler(
-        engine, tokenizer, speculative=False, telemetry=telemetry
+        engine, tokenizer, telemetry=telemetry
     )
-    # compile everything (incl. the per-bucket fused family) OUTSIDE the
-    # measured window: TTFT under churn must not read as XLA compile time
-    warmup_engine(engine, spec=False, multi_step=sched.multi_step)
+    # compile everything (incl. the per-bucket fused family AND the spec
+    # verify families) OUTSIDE the measured window: TTFT under churn must
+    # not read as XLA compile time
+    warmup_engine(engine, spec=True, multi_step=sched.multi_step)
 
     toks, wall = _run_churn(sched, n_requests, max_tokens)
     stats = engine.stats.snapshot()
@@ -758,6 +771,14 @@ def _phase_serving_churn(config, small):
             "serving_churn_trace_fused_slices": sum(
                 1 for e in slices if e["name"] == "step.fused"
             ),
+            # the full composition made visible: verify steps that ALSO
+            # carried an admission chunk (one dispatch, both jobs)
+            "serving_churn_trace_spec_fused_slices": sum(
+                1 for e in slices if e["name"] == "step.spec_fused"
+            ),
+            "serving_churn_trace_spec_slices": sum(
+                1 for e in slices if e["name"] == "step.spec_pipelined"
+            ),
             "serving_churn_trace_pipelined_slices": sum(
                 1 for e in slices if e["name"] == "step.pipelined"
             ),
@@ -775,10 +796,26 @@ def _phase_serving_churn(config, small):
         "serving_churn_tbt_ms_p95": pct_ms(telemetry.tbt, 0.95),
         "serving_churn_queue_wait_ms_p95": pct_ms(telemetry.queue_wait, 0.95),
         # the headline churn evidence: admissions rode fused dispatches
-        # inside the live chain instead of flushing it
+        # and drafts rode spec-verify dispatches inside the live chain —
+        # pipeline_flushes MUST read 0 (no systematic flush class remains)
         "serving_churn_pipeline_flushes": stats["pipeline_flushes"],
         "serving_churn_fused_steps": stats["fused_steps"],
         "serving_churn_pipeline_dispatches": stats["pipeline_dispatches"],
+        # zero-flush speculation: verify steps dispatched in-chain, and
+        # tokens consumed per DRAFTED-lane verify step (1.0 = drafts never
+        # accepted, K+1 = full acceptance; > 1 means speculation's extra
+        # tokens multiplied with the overlap instead of aborting it)
+        "serving_churn_spec_pipelined_steps": stats["spec_pipelined_steps"],
+        "serving_churn_spec_emitted_per_dispatch": (
+            round(stats["spec_emitted"] / stats["spec_lane_steps"], 3)
+            if stats["spec_lane_steps"] else None
+        ),
+        "serving_churn_spec_accept_hist": {
+            str(k): v for k, v in sorted(stats["spec_accept_hist"].items())
+        },
+        # must read 0: the exact on-device sampler serves wide-nucleus
+        # lanes; host_sampling=True is the only remaining host-exact path
+        "serving_churn_host_exact_lanes": stats["host_exact_lanes"],
         "serving_churn_admission_stall_s": round(
             stats["admission_stall_s"], 4
         ),

@@ -47,10 +47,14 @@ from .core import (
 
 SCOPE = ("runtime/engine.py", "runtime/scheduler.py")
 # the dispatch halves by name: the engine's public dispatch entry points
-# (plain pipelined step + the fused prefill+decode admission step) and the
-# scheduler's dispatch-half method
+# (plain pipelined step, the fused prefill+decode admission step, and the
+# zero-flush spec-verify family — the draft-shipping steps must not sync
+# any more than the plain ones) and the scheduler's dispatch-half method,
+# whose draft-probing branch is a pure host-side n-gram lookup (legal);
+# any device sync in it is a finding
 PIPELINE_FUNCS = (
-    "decode_pipelined", "decode_prefill_fused", "_pipeline_dispatch",
+    "decode_pipelined", "decode_prefill_fused", "decode_spec_pipelined",
+    "decode_spec_prefill_fused", "_pipeline_dispatch",
 )
 
 SYNC_METHODS = {"item", "tolist", "block_until_ready", "all_logits",

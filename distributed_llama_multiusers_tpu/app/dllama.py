@@ -206,8 +206,10 @@ def run_chat(args) -> None:
             cur = nxt
         # spec lookahead past EOS is uncommitted cache scribble; the next
         # turn's prefill overwrites it from pos, so only the host-side
-        # buffer needs clearing
-        spec.pending.clear()
+        # buffer needs clearing — discard_pending also RETRACTS the
+        # partially consumed verify step from the acceptance counters, so
+        # turn boundaries cannot skew the spec stats (the PR-9 leak fix)
+        spec.discard_pending()
         print()
 
 

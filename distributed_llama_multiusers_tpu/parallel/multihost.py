@@ -48,7 +48,13 @@ from ..utils import faults
 # op N" crash deep in the replay switch that burns a supervised restart
 # on a packet that was never valid.
 PACKET_MAGIC = 0x444C4C41  # "DLLA"
-PROTOCOL_VERSION = 1
+# v2: zero-flush serving — SLOTS grew 7 -> 9 (the fused spec packet carries
+# drafts + lengths + chunk + prefill header) and two new ops landed
+# (OP_DECODE_SPEC_PIPELINED / OP_DECODE_SPEC_PREFILL_FUSED). The packet
+# SIZE changed, so a v1 peer cannot even frame a v2 broadcast — the
+# version word turns that into a classified ReplayError instead of a
+# garbage replay.
+PROTOCOL_VERSION = 2
 
 OP_STOP = 0
 OP_PREFILL = 1
@@ -66,6 +72,16 @@ OP_DECODE_PREFILL_FUSED = 9  # stall-free admission: ONE dispatch that both
 # advances the pipelined decode lanes and consumes a bounded prompt chunk
 # for one admitting lane — bucket + chunk header ride the packet so every
 # process compiles/replays the identical per-bucket fused program
+OP_DECODE_SPEC_PIPELINED = 10  # zero-flush speculation: a spec verify step
+# INSIDE the pipelined ring — drafts (flattened [n * (SPEC_DRAFT+1)],
+# candidate 0 = the host's guess at the device carry) + per-lane lengths
+# ride slots 5/6 behind the magic/version header; feed flag + ring depth
+# in the DECODE_PIPELINED header slots, so workers replay the same chain
+# with the same bounded lag
+OP_DECODE_SPEC_PREFILL_FUSED = 11  # the full composition: an admitting
+# prompt chunk AND a spec verify step share one dispatch — the
+# SPEC_PIPELINED slots plus the chunk (slot 7) and the prefill header
+# (slot 8, the DECODE_PREFILL_FUSED layout)
 
 
 class ReplayError(RuntimeError):
@@ -137,22 +153,43 @@ class ControlPlane:
     [p_lane, p_start, p_n, p_temp bits, p_topp bits, p_seed bits] — the
     chunk length p_n picks the prefill bucket, so every process compiles
     and replays the identical fused prefill+decode program.
+    DECODE_SPEC_PIPELINED: the DECODE_PIPELINED slots plus payload_f =
+    the in-chain drafts (flattened [n_lanes * (SPEC_DRAFT+1)] — candidate
+    0 per lane is the host's guess at the device carry token) and
+    payload_g = per-lane draft lengths.
+    DECODE_SPEC_PREFILL_FUSED: the DECODE_SPEC_PIPELINED slots plus
+    payload_h = the prompt-chunk tokens and payload_i = the prefill
+    header (the DECODE_PREFILL_FUSED layout) — an admitting chunk and a
+    spec verify step replay as ONE packet.
     DECODE also rides its want_logits flag in the ``lane`` header field:
     the logits-materializing and no-logits steps are different compiled
     programs, and every process must dispatch the same one.
     """
 
     HEADER = 6  # [magic, version, op, lane, n, start_pos]
-    SLOTS = 7
+    SLOTS = 9
 
     def __init__(self, n_lanes: int, chunk: int = 1024):
         from ..runtime.spec import SPEC_DRAFT
 
         self.n_lanes = n_lanes
         # every slot must fit its largest payload: prompt chunks (chunk),
-        # per-lane vectors (n_lanes), and the flattened spec drafts
-        self.chunk = max(chunk, n_lanes, n_lanes * SPEC_DRAFT)
+        # per-lane vectors (n_lanes), and the flattened in-chain drafts
+        # (SPEC_DRAFT + 1 candidates per lane)
+        self.chunk = max(chunk, n_lanes, n_lanes * (SPEC_DRAFT + 1))
         self._size = self.HEADER + self.SLOTS * self.chunk
+
+    def _check_spec_payload(self, flat: np.ndarray) -> np.ndarray:
+        """One copy of the drafts-fit-the-slot guard (constructor sizing
+        guarantees it for engines the plane was built for; a mismatched
+        plane must die before any packet goes out)."""
+        if len(flat) > self.chunk:
+            raise ValueError(
+                f"spec drafts payload {len(flat)} exceeds packet slot "
+                f"{self.chunk}; size ControlPlane for the engine's "
+                "draft layout"
+            )
+        return flat
 
     def _bcast(self, pkt: np.ndarray) -> np.ndarray:
         from jax.experimental import multihost_utils
@@ -240,16 +277,54 @@ class ControlPlane:
             phdr,
         )
 
+    def send_decode_spec_pipelined(
+        self, tokens, positions, temps, topps, seeds, depth: int,
+        drafts, draft_len,
+    ) -> None:
+        n = len(positions)
+        flat = self._check_spec_payload(np.asarray(drafts, np.int32).reshape(-1))
+        # DECODE_PIPELINED header layout (feed flag in `lane`, ring depth
+        # in `start_pos`); drafts + lengths ride slots 5/6
+        self._send(
+            OP_DECODE_SPEC_PIPELINED, 0 if tokens is None else 1, n, depth,
+            tokens, positions,
+            np.asarray(temps, np.float32).view(np.int32),
+            np.asarray(topps, np.float32).view(np.int32),
+            np.asarray(seeds, np.uint32).view(np.int32),
+            flat,
+            np.asarray(draft_len, np.int32),
+        )
+
+    def send_decode_spec_prefill_fused(
+        self, tokens, positions, temps, topps, seeds, depth: int,
+        drafts, draft_len, p_lane: int, chunk, p_start: int,
+        p_temp: float, p_topp: float, p_seed: int,
+    ) -> None:
+        n = len(positions)
+        flat = self._check_spec_payload(np.asarray(drafts, np.int32).reshape(-1))
+        phdr = np.zeros(6, np.int32)
+        phdr[0:3] = (p_lane, p_start, len(chunk))
+        phdr[3] = np.asarray([p_temp], np.float32).view(np.int32)[0]
+        phdr[4] = np.asarray([p_topp], np.float32).view(np.int32)[0]
+        phdr[5] = np.asarray([p_seed & 0xFFFFFFFF], np.uint32).view(np.int32)[0]
+        self._send(
+            OP_DECODE_SPEC_PREFILL_FUSED, 0 if tokens is None else 1, n,
+            depth,
+            tokens, positions,
+            np.asarray(temps, np.float32).view(np.int32),
+            np.asarray(topps, np.float32).view(np.int32),
+            np.asarray(seeds, np.uint32).view(np.int32),
+            flat,
+            np.asarray(draft_len, np.int32),
+            np.asarray(chunk, np.int32),
+            phdr,
+        )
+
     def send_decode_spec(
         self, tokens, drafts, draft_len, positions, temps, topps, seeds
     ) -> None:
         n = len(tokens)
-        flat = np.asarray(drafts, np.int32).reshape(-1)
-        if len(flat) > self.chunk:  # constructor sizing guarantees this fits
-            raise ValueError(
-                f"spec drafts payload {len(flat)} exceeds packet slot "
-                f"{self.chunk}; size ControlPlane for n_lanes*SPEC_DRAFT"
-            )
+        flat = self._check_spec_payload(np.asarray(drafts, np.int32).reshape(-1))
         self._send(
             OP_DECODE_SPEC, 0, n, 0,
             tokens, positions,
@@ -412,9 +487,10 @@ class RootControlEngine:
         host-only (they dispatch no device program, so there is nothing to
         replay) and forward through __getattr__; workers bound their own
         rings from the depth in the header."""
-        # ring-full/missing-carry must raise BEFORE the packet goes out: a
-        # broadcast with no matching root-side compute desyncs the pod
-        self._engine.check_pipelined_dispatch(tokens is not None)
+        # ring-full/missing-carry/bad-reseed-position must raise BEFORE the
+        # packet goes out: a broadcast with no matching root-side compute
+        # desyncs the pod
+        self._engine.check_pipelined_dispatch(tokens is not None, positions)
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_pipelined(
             None if tokens is None else np.asarray(tokens, np.int32),
@@ -424,6 +500,22 @@ class RootControlEngine:
         return self._engine.decode_pipelined(
             positions, temps, topps, seeds, tokens=tokens
         )
+
+    def _check_fused_chunk(self, chunk, p_topp):
+        """ONE copy of the fused-admission chunk validation + topp default
+        (both fused entry points — plain and spec-carrying — must enforce
+        the identical pre-broadcast rule or the pod-deadlock guarantee
+        drifts between them). Returns the resolved p_topp."""
+        if p_topp is None:  # byte-identical default on packet AND root call
+            from ..runtime.engine import DEFAULT_TOPP as p_topp
+        limit = min(self._plane.chunk, self._engine.max_chunk())
+        if chunk is None or not 1 <= len(chunk) <= limit:
+            raise ValueError(
+                f"fused prefill chunk of {0 if chunk is None else len(chunk)} "
+                f"outside [1, {limit}] (plane packet capacity "
+                f"{self._plane.chunk}, engine bucket {self._engine.max_chunk()})"
+            )
+        return p_topp
 
     def decode_prefill_fused(
         self, positions, temps=None, topps=None, seeds=None,
@@ -436,23 +528,15 @@ class RootControlEngine:
         process dispatches the identical per-bucket fused program. The
         multihost prefill path for a mid-serving admission IS this op:
         no separate OP_PREFILL round is broadcast."""
-        if p_topp is None:  # byte-identical default on packet AND root call
-            from ..runtime.engine import DEFAULT_TOPP as p_topp
         # validate BEFORE broadcasting (the prefill_chunk rule): every
         # packet must pair with exactly one root-side compute or the pod
         # deadlocks on mismatched collectives. The packet-capacity check
         # plus the FULL engine validation set (chunk bounds, seq_len
         # overflow, ring-full, missing carry) — any of those raising after
         # the broadcast would leave worker rings permanently diverged
-        limit = min(self._plane.chunk, self._engine.max_chunk())
-        if chunk is None or not 1 <= len(chunk) <= limit:
-            raise ValueError(
-                f"fused prefill chunk of {0 if chunk is None else len(chunk)} "
-                f"outside [1, {limit}] (plane packet capacity "
-                f"{self._plane.chunk}, engine bucket {self._engine.max_chunk()})"
-            )
+        p_topp = self._check_fused_chunk(chunk, p_topp)
         self._engine.check_fused_dispatch(
-            list(chunk), p_start, tokens is not None
+            list(chunk), p_start, tokens is not None, positions
         )
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_prefill_fused(
@@ -464,6 +548,65 @@ class RootControlEngine:
         )
         return self._engine.decode_prefill_fused(
             positions, temps, topps, seeds,
+            p_lane=p_lane, chunk=list(chunk), p_start=p_start,
+            p_temp=p_temp, p_topp=p_topp, p_seed=p_seed, tokens=tokens,
+        )
+
+    def decode_spec_pipelined(
+        self, positions, drafts, draft_len, temps=None, topps=None,
+        seeds=None, tokens=None,
+    ):
+        """Zero-flush speculation on a pod: the spec-verify packet goes
+        out first (drafts + lengths in their own slots), then the root
+        enqueues its own half of the async chain — every process
+        dispatches the identical verify program with the same bounded
+        lag. The FULL engine validation set (draft shape, ring-full,
+        missing carry) runs BEFORE the broadcast: a packet whose
+        root-side compute raises leaves worker rings permanently
+        diverged (the pod-deadlock rule)."""
+        drafts = np.asarray(drafts, np.int32)
+        self._engine.check_spec_pipelined_dispatch(
+            drafts, tokens is not None, positions
+        )
+        temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._plane.send_decode_spec_pipelined(
+            None if tokens is None else np.asarray(tokens, np.int32),
+            np.asarray(positions, np.int32), temps, topps, seeds,
+            depth=getattr(self._engine, "pipeline_depth", 2),
+            drafts=drafts, draft_len=np.asarray(draft_len, np.int32),
+        )
+        return self._engine.decode_spec_pipelined(
+            positions, drafts, draft_len, temps, topps, seeds,
+            tokens=tokens,
+        )
+
+    def decode_spec_prefill_fused(
+        self, positions, drafts, draft_len, temps=None, topps=None,
+        seeds=None, p_lane: int = 0, chunk=None, p_start: int = 0,
+        p_temp: float = 0.0, p_topp: float | None = None, p_seed: int = 0,
+        tokens=None,
+    ):
+        """The full composition on a pod: an admitting chunk and a spec
+        verify step replay as ONE packet. Validation is the union of the
+        fused-prefill and spec-pipelined pre-broadcast sets — all of it
+        BEFORE the packet goes out."""
+        p_topp = self._check_fused_chunk(chunk, p_topp)
+        drafts = np.asarray(drafts, np.int32)
+        self._engine.check_spec_drafts(drafts)
+        self._engine.check_fused_dispatch(
+            list(chunk), p_start, tokens is not None, positions
+        )
+        temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._plane.send_decode_spec_prefill_fused(
+            None if tokens is None else np.asarray(tokens, np.int32),
+            np.asarray(positions, np.int32), temps, topps, seeds,
+            depth=getattr(self._engine, "pipeline_depth", 2),
+            drafts=drafts, draft_len=np.asarray(draft_len, np.int32),
+            p_lane=p_lane, chunk=list(chunk), p_start=p_start,
+            p_temp=p_temp, p_topp=p_topp, p_seed=p_seed,
+        )
+        return self._engine.decode_spec_prefill_fused(
+            positions, drafts, draft_len, temps, topps, seeds,
             p_lane=p_lane, chunk=list(chunk), p_start=p_start,
             p_temp=p_temp, p_topp=p_topp, p_seed=p_seed, tokens=tokens,
         )
@@ -612,6 +755,48 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 4, n).view(np.uint32),
                 p_lane=int(phdr[0]),
                 chunk=[int(t) for t in plane.slot(pkt, 5, int(phdr[2]))],
+                p_start=int(phdr[1]),
+                p_temp=float(phdr[3:4].view(np.float32)[0]),
+                p_topp=float(phdr[4:5].view(np.float32)[0]),
+                p_seed=int(phdr[5:6].view(np.uint32)[0]),
+                tokens=plane.slot(pkt, 0, n) if lane else None,
+            )
+        elif op == OP_DECODE_SPEC_PIPELINED:
+            # the pipelined replay rules (feed flag in `lane`, ring depth
+            # in `start_pos`, bounded-lag consume) with the in-chain
+            # drafts + lengths riding slots 5/6
+            if lane:
+                engine.pipeline_flush(count=False)  # reseed: same lagged drain
+            elif engine.pipeline_inflight() >= max(1, start_pos):
+                engine.pipeline_consume()
+            k1 = engine.SPEC_DRAFT + 1
+            engine.decode_spec_pipelined(
+                plane.slot(pkt, 1, n),
+                plane.slot(pkt, 5, n * k1).reshape(n, k1),
+                plane.slot(pkt, 6, n),
+                plane.slot(pkt, 2, n).view(np.float32),
+                plane.slot(pkt, 3, n).view(np.float32),
+                plane.slot(pkt, 4, n).view(np.uint32),
+                tokens=plane.slot(pkt, 0, n) if lane else None,
+            )
+        elif op == OP_DECODE_SPEC_PREFILL_FUSED:
+            # the SPEC_PIPELINED rules plus the chunk + prefill header in
+            # slots 7/8 — chunk and spec verify replay as one program
+            if lane:
+                engine.pipeline_flush(count=False)  # reseed: same lagged drain
+            elif engine.pipeline_inflight() >= max(1, start_pos):
+                engine.pipeline_consume()
+            k1 = engine.SPEC_DRAFT + 1
+            phdr = plane.slot(pkt, 8, 6)
+            engine.decode_spec_prefill_fused(
+                plane.slot(pkt, 1, n),
+                plane.slot(pkt, 5, n * k1).reshape(n, k1),
+                plane.slot(pkt, 6, n),
+                plane.slot(pkt, 2, n).view(np.float32),
+                plane.slot(pkt, 3, n).view(np.float32),
+                plane.slot(pkt, 4, n).view(np.uint32),
+                p_lane=int(phdr[0]),
+                chunk=[int(t) for t in plane.slot(pkt, 7, int(phdr[2]))],
                 p_start=int(phdr[1]),
                 p_temp=float(phdr[3:4].view(np.float32)[0]),
                 p_topp=float(phdr[4:5].view(np.float32)[0]),

@@ -74,6 +74,16 @@ class EngineStats:
     prefix_tokens_saved: int = 0  # prompt tokens NOT re-prefilled
     multi_dispatches: int = 0  # decode_multi calls (each = h decode steps,
     # ONE host round-trip — the serving loop's per-token dispatch amortizer)
+    # zero-flush serving (decode_spec_pipelined / decode_spec_prefill_fused):
+    spec_pipelined_steps: int = 0  # spec verify steps dispatched INSIDE the
+    # pipelined ring (each also counts in spec_steps/pipeline_dispatches)
+    spec_accept_hist: dict = field(default_factory=dict)  # device accept
+    # count -> occurrences, DRAFTED lanes only (0 = no draft survived the
+    # carry-alignment gate, K = full acceptance); written by the consuming
+    # scheduler, which is the only layer that knows which lanes drafted
+    host_exact_lanes: int = 0  # lanes routed through the host Sampler
+    # (host_sampling=True escape hatch only — the on-device sampler is
+    # full-vocab exact, so this reads 0 in default serving)
     # async decode pipeline (decode_pipelined / pipeline_consume):
     overlap_s: float = 0.0  # host-side time between a step's dispatch and
     # the start of its (lagged) readback — work the device execution hid,
@@ -131,6 +141,7 @@ class EngineStats:
             "prefill_s", "decode_s", "prefill_tokens", "decode_steps",
             "host_bytes_in", "spec_steps", "spec_emitted", "spec_lane_steps",
             "prefix_hits", "prefix_tokens_saved", "multi_dispatches",
+            "spec_pipelined_steps", "spec_accept_hist", "host_exact_lanes",
             "overlap_s", "pipeline_dispatches", "pipeline_flushes",
             "pipeline_depth_hist",
             "fused_steps", "admission_stall_s", "fused_bucket_hist",
@@ -161,6 +172,8 @@ class EngineStats:
             self.spec_steps = self.spec_emitted = self.spec_lane_steps = 0
             self.prefix_hits = self.prefix_tokens_saved = 0
             self.multi_dispatches = 0
+            self.spec_pipelined_steps = self.host_exact_lanes = 0
+            self.spec_accept_hist = {}
             self.pipeline_dispatches = self.pipeline_flushes = 0
             self.pipeline_depth_hist = {}
             self.fused_steps = 0
@@ -239,8 +252,18 @@ class InferenceEngine:
             DEFAULT_PIPELINE_DEPTH if pipeline_depth is None
             else max(0, pipeline_depth)
         )
-        self._pl_inflight: deque = deque()  # (packed tokens dev array, t_dispatched)
+        # ring entries: (kind, packed device array, t_dispatched) with kind
+        # "tok" ([2, n(+1)] greedy/sampled rows) or "spec" ([n(+1), K+2]
+        # emitted tokens + per-lane emit count)
+        self._pl_inflight: deque = deque()
         self._pl_carry = None  # [n] device int32: next feed per lane
+        # [n] device int32: each lane's next WRITE position — part of the
+        # carry since spec verify steps advance lanes by a per-lane accept
+        # count the host only learns one step later (pos+1 generalizes to
+        # pos+accepted+1). Dispatch positions with value -1 select this
+        # carried position; >= 0 overrides from host metadata (parked /
+        # admitting / freshly reseeded lanes).
+        self._pl_carry_pos = None
 
         cfg = config
         q80 = emulate_q80_activations
@@ -278,17 +301,27 @@ class InferenceEngine:
         else:
             rep_tokens = lambda x: x
 
-        topk = self.device_topk
+        # EXACT on-device top-p: the nucleus is computed over the FULL
+        # vocab (top_k with k == vocab_size is a total descending sort), so
+        # no truncation class exists and wide-nucleus / high-temperature
+        # requests sample on device like everyone else — the host Sampler
+        # survives only as the host_sampling=True escape hatch.
+        # (device_topk is kept as a constructor knob for API compatibility
+        # but no longer truncates sampling.)
+        nucleus_k = cfg.vocab_size
 
         def _sample_lane(row, temp, topp, seed, pos, greedy):
-            """Top-k truncated nucleus sample for one lane, on device.
+            """Exact nucleus sample for one lane, on device: full-vocab
+            sort → cumulative sum → nucleus mask → categorical draw.
 
             Reproduces the reference Sampler's sort→cumsum→cutoff shape
-            (src/tokenizer.cpp:416-457) over the top-`device_topk` logits
-            (exact when the nucleus fits in k, the overwhelmingly common
-            case; the host Sampler remains the bit-exact xorshift path).
-            Deterministic per (seed, position): seeded runs reproduce."""
-            vals, idx = jax.lax.top_k(row, topk)
+            (src/tokenizer.cpp:416-457) over the WHOLE vocab, so the kept
+            set equals the host Sampler's exact nucleus for any (temp,
+            topp); only the RNG differs (fold_in(seed, pos) + categorical
+            here vs xorshift64* there — pinned by
+            tests/test_sampler_parity.py). Deterministic per (seed,
+            position): seeded runs reproduce."""
+            vals, idx = jax.lax.top_k(row, nucleus_k)
             t = jnp.maximum(temp, 1e-6)
             p = jax.nn.softmax(vals.astype(jnp.float32) / t)
             csum = jnp.cumsum(p)
@@ -307,6 +340,22 @@ class InferenceEngine:
             )
         )
 
+        def _sample_lanes_or_greedy(step, temps, topps, seeds, positions,
+                                    greedy):
+            # the full-vocab sort is only worth paying when some lane
+            # actually samples: an XLA Conditional (ONE branch executes at
+            # runtime, unlike a select) skips the whole sampler for
+            # all-greedy batches — the common serving case — with a single
+            # compiled program, so no program-selection flag has to ride
+            # the pod control packets
+            return jax.lax.cond(
+                jnp.any(temps > 0.0),
+                lambda: self._sample_lanes(
+                    step, temps, topps, seeds, positions, greedy
+                ),
+                lambda: greedy,
+            )
+
         def _decode_core(params, cache, tokens, positions, temps, topps, seeds):
             # tokens/positions: [n_lanes] -> [n_lanes, 1]
             logits, cache = llama_forward(
@@ -317,7 +366,9 @@ class InferenceEngine:
             greedy = jnp.argmax(step, axis=-1).astype(jnp.int32)
             # sampling fused into the compiled step: a sampled lane costs a
             # 4-byte token transfer, not a [vocab] f32 row (VERDICT Weak #3)
-            sampled = self._sample_lanes(step, temps, topps, seeds, positions, greedy)
+            sampled = _sample_lanes_or_greedy(
+                step, temps, topps, seeds, positions, greedy
+            )
             return step, greedy, sampled, cache
 
         @partial(jax.jit, donate_argnums=(1,))
@@ -346,20 +397,144 @@ class InferenceEngine:
             )
             return rep_tokens(jnp.stack([greedy, sampled])), cache
 
+        def _eff_positions(carry_pos, pos_host):
+            # the carried-position select: host positions >= 0 override
+            # (parked / admitting / reseeded lanes), -1 reads the device
+            # carry — the only layer that knows a lane's position once a
+            # spec verify step with a per-lane accept count is in flight
+            return jnp.where(pos_host < 0, carry_pos, pos_host)
+
         @partial(jax.jit, donate_argnums=(1,))
-        def _decode_pl(params, cache, tokens, positions, temps, topps, seeds):
+        def _decode_pl(params, cache, tokens, carry_pos, positions, temps,
+                       topps, seeds):
             # pipelined step: the per-lane feed rule (greedy lanes continue
             # with argmax, device-sampled lanes with the fused sample — the
             # same select the decode_multi scan body applies) runs ON DEVICE
             # and comes back as the carry for the NEXT dispatch, so step k+1
-            # needs no host readback of step k at all
+            # needs no host readback of step k at all. Positions ride the
+            # carry too (clamped at seq_len, where the KV scatter drops
+            # writes — the same park rule the host applies).
+            pos = _eff_positions(carry_pos, positions)
             _, greedy, sampled, cache = _decode_core(
-                params, cache, tokens, positions, temps, topps, seeds
+                params, cache, tokens, pos, temps, topps, seeds
             )
             nxt = jnp.where(temps == 0.0, greedy, sampled)
+            new_pos = jnp.minimum(pos + 1, cfg.seq_len)
             return (
                 rep_tokens(nxt),
+                rep_tokens(new_pos),
                 rep_tokens(jnp.stack([greedy, sampled])),
+                cache,
+            )
+
+        def _spec_verify_core(params, cache, feed, pos, drafts, draft_len,
+                              temps, topps, seeds):
+            """Speculative verify INSIDE the pipelined step family: up to
+            SPEC_DRAFT host-shipped draft tokens are verified against the
+            device's own carry in one forward, per-lane accepted counts
+            advance the position carry (pos + accepted + 1), and the next
+            feed token is the model's continuation after the accepted
+            prefix — exactly ``_decode_spec``'s math with one extra gate:
+
+            drafts[:, 0] is the HOST'S CANDIDATE FOR THE CARRY TOKEN
+            ITSELF. The host probes its n-gram index one step behind the
+            device (its history ends at the token fed into the in-flight
+            step), so it ships K+1 candidates starting at the token it
+            cannot see; the device admits the remaining K only when
+            candidate 0 equals the actual carry (on a reseed the host
+            knows the feed exactly and ships it as candidate 0, so the
+            gate passes trivially). A mismatch costs nothing but the
+            acceptance — verification is against the model's own argmax,
+            so emitted tokens are ALWAYS the plain greedy stream.
+
+            Junk-KV safety is ``_decode_spec``'s contract verbatim, with
+            the draft clamp moved ON DEVICE (the host's stale position
+            could under-clamp): eff_len <= seq_len - pos - 1, and writes
+            at >= seq_len drop in the cache scatter."""
+            hit0 = (drafts[:, 0] == feed) & (draft_len > 0)
+            eff_len = jnp.where(hit0, draft_len - 1, 0)
+            eff_len = jnp.clip(
+                eff_len, 0, jnp.maximum(cfg.seq_len - pos - 1, 0)
+            )
+            full = jnp.concatenate([feed[:, None], drafts[:, 1:]], axis=1)
+            k_spec = full.shape[1]  # SPEC_DRAFT + 1
+            pos2d = pos[:, None] + jnp.arange(k_spec, dtype=jnp.int32)
+            logits, cache = llama_forward(
+                cfg, params, full, pos2d, cache,
+                emulate_q80_activations=q80, mesh=sp_mesh, q80_sync=q80s,
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = (full[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
+            lead = jnp.cumprod(match, axis=1)
+            in_draft = (
+                jnp.arange(k_spec - 1, dtype=jnp.int32)[None, :]
+                < eff_len[:, None]
+            )
+            accepted = jnp.sum(lead * in_draft, axis=1).astype(jnp.int32)
+            n_emit = accepted + 1
+            sampled0 = _sample_lanes_or_greedy(
+                logits[:, 0, :], temps, topps, seeds, pos, greedy[:, 0]
+            )
+            emitted = greedy.at[:, 0].set(
+                jnp.where(temps > 0.0, sampled0, greedy[:, 0])
+            )
+            nxt = jnp.take_along_axis(
+                emitted, (n_emit - 1)[:, None], axis=1
+            )[:, 0]
+            new_pos = jnp.minimum(pos + n_emit, cfg.seq_len)
+            # ONE [n, K+2] lagged transfer: emitted tokens + emit count
+            packed = jnp.concatenate([emitted, n_emit[:, None]], axis=1)
+            return nxt, new_pos, packed, cache
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _decode_spec_pl(params, cache, tokens, carry_pos, positions,
+                            drafts, draft_len, temps, topps, seeds):
+            pos = _eff_positions(carry_pos, positions)
+            nxt, new_pos, packed, cache = _spec_verify_core(
+                params, cache, tokens, pos, drafts, draft_len, temps,
+                topps, seeds,
+            )
+            return (
+                rep_tokens(nxt),
+                rep_tokens(new_pos),
+                rep_tokens(packed),
+                cache,
+            )
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _decode_spec_prefill(params, cache, tokens, carry_pos,
+                                 positions, drafts, draft_len, temps, topps,
+                                 seeds, p_lane, p_tokens, p_start, p_n,
+                                 p_temp, p_topp, p_seed):
+            """Fused admission + speculative verify: ONE dispatch that
+            consumes one bounded prompt chunk for lane ``p_lane`` AND
+            verifies every generating lane's drafts — the composition the
+            zero-flush chain needs when a request is admitting while
+            greedy lanes draft. The prefill half is ``_prefill_half``
+            verbatim (the ``decode_prefill_fused`` contract); the verify
+            half is ``_spec_verify_core``; the packed readback appends the
+            chunk's boundary greedy/sampled pair as one extra ROW
+            ([n+1, K+2] — spec packs are row-per-lane, unlike the
+            [2, n+1] column pack of the plain fused step)."""
+            _, p_greedy, p_sampled, cache = _prefill_half(
+                params, cache, p_lane, p_tokens, p_start, p_n,
+                p_temp, p_topp, p_seed,
+            )
+            pos = _eff_positions(carry_pos, positions)
+            nxt, new_pos, packed, cache = _spec_verify_core(
+                params, cache, tokens, pos, drafts, draft_len, temps,
+                topps, seeds,
+            )
+            p_first = jnp.where(p_temp == 0.0, p_greedy, p_sampled)
+            nxt = nxt.at[p_lane].set(p_first)
+            new_pos = new_pos.at[p_lane].set(p_start + p_n)
+            brow = jnp.zeros((1, packed.shape[1]), jnp.int32)
+            brow = brow.at[0, 0].set(p_greedy).at[0, 1].set(p_sampled)
+            packed = jnp.concatenate([packed, brow], axis=0)
+            return (
+                rep_tokens(nxt),
+                rep_tokens(new_pos),
+                rep_tokens(packed),
                 cache,
             )
 
@@ -404,7 +579,7 @@ class InferenceEngine:
             accepted = jnp.sum(lead * in_draft, axis=1).astype(jnp.int32)
             n_emit = accepted + 1  # [n]
             # lane 0-position sample for temp>0 lanes (their draft_len is 0)
-            sampled0 = self._sample_lanes(
+            sampled0 = _sample_lanes_or_greedy(
                 logits[:, 0, :], temps, topps, seeds, positions, greedy[:, 0]
             )
             emitted = greedy.at[:, 0].set(
@@ -451,8 +626,14 @@ class InferenceEngine:
             v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)
             last = jax.lax.dynamic_index_in_dim(logits[0], n_tokens - 1, axis=0, keepdims=False)
             greedy = jnp.argmax(last).astype(jnp.int32)
-            sampled = _sample_lane(
-                last, temp, topp, seed, start_pos + n_tokens - 1, greedy
+            # same runtime gate as the decode families: a greedy admission
+            # (temp 0) skips the full-vocab sampler sort entirely
+            sampled = jax.lax.cond(
+                temp > 0.0,
+                lambda: _sample_lane(
+                    last, temp, topp, seed, start_pos + n_tokens - 1, greedy
+                ),
+                lambda: greedy,
             )
             return last, greedy, sampled, KVCache(k=k, v=v)
 
@@ -470,9 +651,9 @@ class InferenceEngine:
             )
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _decode_prefill(params, cache, feed, positions, temps, topps,
-                            seeds, p_lane, p_tokens, p_start, p_n, p_temp,
-                            p_topp, p_seed):
+        def _decode_prefill(params, cache, feed, carry_pos, positions,
+                            temps, topps, seeds, p_lane, p_tokens, p_start,
+                            p_n, p_temp, p_topp, p_seed):
             """Fused prefill+decode: ONE device dispatch that consumes one
             bucketed prompt chunk for lane ``p_lane`` AND advances every
             generating lane one pipelined decode step — the stall-free
@@ -502,8 +683,9 @@ class InferenceEngine:
                 params, cache, p_lane, p_tokens, p_start, p_n,
                 p_temp, p_topp, p_seed,
             )
+            pos = _eff_positions(carry_pos, positions)
             _, greedy, sampled, cache = _decode_core(
-                params, cache, feed, positions, temps, topps, seeds
+                params, cache, feed, pos, temps, topps, seeds
             )
             nxt = jnp.where(temps == 0.0, greedy, sampled)
             # host-exact admissions never take the fused path, so the
@@ -511,6 +693,10 @@ class InferenceEngine:
             # select the sync _prefill_step applies
             p_first = jnp.where(p_temp == 0.0, p_greedy, p_sampled)
             nxt = nxt.at[p_lane].set(p_first)
+            # the joined lane's NEXT write position is the chunk boundary:
+            # carried on device so the lane can ride spec steps immediately
+            new_pos = jnp.minimum(pos + 1, cfg.seq_len)
+            new_pos = new_pos.at[p_lane].set(p_start + p_n)
             packed = jnp.concatenate(
                 [
                     jnp.stack([greedy, sampled]),
@@ -518,7 +704,12 @@ class InferenceEngine:
                 ],
                 axis=1,
             )
-            return rep_tokens(nxt), rep_tokens(packed), cache
+            return (
+                rep_tokens(nxt),
+                rep_tokens(new_pos),
+                rep_tokens(packed),
+                cache,
+            )
 
         @partial(jax.jit, donate_argnums=(0,))
         def _copy_lane(cache, src, dst):
@@ -558,7 +749,7 @@ class InferenceEngine:
                     )
                     step = logits[:, 0, :]
                     greedy = jnp.argmax(step, axis=-1).astype(jnp.int32)
-                    sampled = self._sample_lanes(
+                    sampled = _sample_lanes_or_greedy(
                         step, temps, topps, seeds, pos, greedy
                     )
                     nxt = jnp.where(temps == 0.0, greedy, sampled)
@@ -579,6 +770,8 @@ class InferenceEngine:
         self._decode_fn = _decode
         self._decode_nologits_fn = _decode_nologits
         self._decode_pl_fn = _decode_pl
+        self._decode_spec_pl_fn = _decode_spec_pl
+        self._decode_spec_prefill_fn = _decode_spec_prefill
         self._prefill_fn = _prefill
         # AOT-compiled decode executable (set by collective_stats, which
         # must lower+compile to read the post-SPMD HLO): reused for dispatch
@@ -807,9 +1000,13 @@ class InferenceEngine:
         the token the synchronous loop would have fed after its readback —
         so chained dispatches never round-trip tokens through the host.
         Passing a host ``tokens`` array (re)seeds the chain (the first step
-        after a flush). Positions/temps/topps/seeds are host metadata the
-        scheduler already knows (each consumed step advances a live lane by
-        exactly 1), so they ride each dispatch without any sync.
+        after a flush). Temps/topps/seeds are host metadata riding each
+        dispatch without any sync; a position of ``-1`` selects the
+        DEVICE-CARRIED position for that lane (required once a spec verify
+        step — whose per-lane accept count the host learns one step late —
+        is anywhere in the chain), while ``>= 0`` overrides from host
+        metadata (parked/admitting lanes at seq_len, real positions on a
+        reseed — a reseed must not pass -1 anywhere, there is no carry).
 
         The ring is bounded at ``pipeline_depth``: callers must
         ``pipeline_consume()`` the oldest step before dispatching past it.
@@ -824,23 +1021,22 @@ class InferenceEngine:
             topps = np.full(n, DEFAULT_TOPP, np.float32)
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
-        self.check_pipelined_dispatch(tokens is not None)
+        self.check_pipelined_dispatch(tokens is not None, positions)
         faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
-        if tokens is None:
-            feed = self._pl_carry
-        else:
-            feed = jnp.asarray(tokens, jnp.int32)
-        nxt, packed, self.cache = self._decode_pl_fn(
+        feed, carry_pos = self._pl_feed(tokens, positions)
+        nxt, new_pos, packed, self.cache = self._decode_pl_fn(
             self.params,
             self.cache,
             feed,
+            carry_pos,
             jnp.asarray(positions, jnp.int32),
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(topps, jnp.float32),
             jnp.asarray(seeds, jnp.uint32),
         )
         self._pl_carry = nxt
-        self._pl_inflight.append((packed, time.perf_counter()))
+        self._pl_carry_pos = new_pos
+        self._pl_inflight.append(("tok", packed, time.perf_counter()))
         with self.stats.lock:
             self.stats.pipeline_dispatches += 1
             self.stats.sync_bytes_total += self.stats.sync_bytes_per_decode
@@ -852,12 +1048,36 @@ class InferenceEngine:
     # pod roots broadcast fused admission steps as OP_DECODE_PREFILL_FUSED
     supports_fused_prefill = True
 
-    def check_pipelined_dispatch(self, reseed: bool) -> None:
+    def _pl_feed(self, tokens, positions):
+        """Resolve the (feed tokens, carried positions) operand pair for a
+        pipelined-family dispatch: the device carry when chained
+        (``tokens is None``), host arrays on a reseed — where the carried-
+        position operand is a zeros placeholder the ``-1`` select never
+        reads, because a reseed must pass real positions everywhere."""
+        if tokens is None:
+            return self._pl_carry, self._pl_carry_pos
+        return (
+            jnp.asarray(tokens, jnp.int32),
+            jnp.zeros(self.n_lanes, jnp.int32),
+        )
+
+    def check_pipelined_dispatch(self, reseed: bool,
+                                 positions=None) -> None:
         """Raise every host-side error a pipelined dispatch would, WITHOUT
         dispatching: pod roots call this before broadcasting the control
         packet so a bad call dies on the root with ZERO packets out — a
         packet whose root-side compute never happens leaves worker rings
-        and carries desynced and deadlocks the next collective."""
+        and carries desynced and deadlocks the next collective. The
+        reseed-position rule is part of this set for the same reason: a
+        ``-1`` carried-position sentinel on a reseed (there is no carry to
+        read) must die BEFORE any packet, not in every process's
+        ``_pl_feed`` mid-replay."""
+        if reseed and positions is not None and int(np.min(positions)) < 0:
+            raise ValueError(
+                "reseed dispatch with a -1 position: the carried-position "
+                "select has no carry to read on a reseed — pass real "
+                "positions for every lane"
+            )
         if len(self._pl_inflight) >= max(1, self.pipeline_depth):
             raise RuntimeError(
                 f"pipeline ring full (depth {self.pipeline_depth}): consume "
@@ -869,7 +1089,8 @@ class InferenceEngine:
                 "(first dispatch after construction or a flush)"
             )
 
-    def check_fused_dispatch(self, chunk, p_start: int, reseed: bool) -> None:
+    def check_fused_dispatch(self, chunk, p_start: int, reseed: bool,
+                             positions=None) -> None:
         """``check_pipelined_dispatch`` plus the prompt-chunk bounds the
         fused prefill half enforces — the full pre-broadcast validation
         set for OP_DECODE_PREFILL_FUSED."""
@@ -884,7 +1105,7 @@ class InferenceEngine:
                 f"chunk of {len(chunk)} tokens at pos {p_start} exceeds "
                 f"seq_len {self.config.seq_len}"
             )
-        self.check_pipelined_dispatch(reseed)
+        self.check_pipelined_dispatch(reseed, positions)
 
     def decode_prefill_fused(
         self,
@@ -928,19 +1149,18 @@ class InferenceEngine:
             topps = np.full(n, DEFAULT_TOPP, np.float32)
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
-        self.check_fused_dispatch(chunk, p_start, tokens is not None)
+        self.check_fused_dispatch(chunk, p_start, tokens is not None,
+                                  positions)
         faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
-        if tokens is None:
-            feed = self._pl_carry
-        else:
-            feed = jnp.asarray(tokens, jnp.int32)
+        feed, carry_pos = self._pl_feed(tokens, positions)
         bucket = self.bucket_for(len(chunk))
         padded = np.zeros(bucket, np.int32)
         padded[: len(chunk)] = chunk
-        nxt, packed, self.cache = self._decode_prefill_fn(
+        nxt, new_pos, packed, self.cache = self._decode_prefill_fn(
             self.params,
             self.cache,
             feed,
+            carry_pos,
             jnp.asarray(positions, jnp.int32),
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(topps, jnp.float32),
@@ -954,7 +1174,8 @@ class InferenceEngine:
             jnp.uint32(p_seed & 0xFFFFFFFF),
         )
         self._pl_carry = nxt
-        self._pl_inflight.append((packed, time.perf_counter()))
+        self._pl_carry_pos = new_pos
+        self._pl_inflight.append(("tok", packed, time.perf_counter()))
         with self.stats.lock:
             self.stats.pipeline_dispatches += 1
             self.stats.fused_steps += 1
@@ -970,19 +1191,25 @@ class InferenceEngine:
 
     def pipeline_consume(self):
         """Blocking readback of the OLDEST in-flight pipelined step — the
-        lagged half of the pipeline: while this step's [2, n] token rows
-        (or [2, n+1] for a fused prefill+decode step — the extra column is
-        the chunk's boundary token pair) cross to the host, the younger
-        dispatches keep the device busy.
-        Returns (greedy np[n|n+1], sampled np[n|n+1]); the token a lane
-        fed into the NEXT in-flight step is greedy[i] for temp-0 lanes and
-        sampled[i] otherwise (the on-device feed rule)."""
+        lagged half of the pipeline: while this step's tokens cross to the
+        host, the younger dispatches keep the device busy.
+
+        Plain/fused steps return (greedy np[n|n+1], sampled np[n|n+1]) —
+        the [2, n] token rows, plus the chunk's boundary pair in the extra
+        column for a fused step; the token a lane fed into the NEXT
+        in-flight step is greedy[i] for temp-0 lanes and sampled[i]
+        otherwise (the on-device feed rule). SPEC verify steps
+        (``decode_spec_pipelined`` family) return
+        (emitted np[n(+1), K+1], n_emit np[n(+1)]) — ``decode_spec``'s
+        readback shape, with the boundary pair riding ``emitted[-1, :2]``
+        when the step also carried a chunk. Callers know which kind they
+        dispatched (the scheduler's meta deque records it)."""
         if not self._pl_inflight:
             raise RuntimeError("pipeline ring empty: nothing to consume")
         faults.fire("engine.consume")  # chaos harness; no-op unarmed
-        packed, dispatched_at = self._pl_inflight.popleft()
+        kind, packed, dispatched_at = self._pl_inflight.popleft()
         t0 = time.perf_counter()
-        # dlint: ok[host-sync] the lagged ONE [2, n] int32 readback per pipelined step (greedy+sampled rows), counted below
+        # dlint: ok[host-sync] the lagged ONE packed int32 readback per pipelined step, counted below
         toks_np = np.asarray(packed)
         t1 = time.perf_counter()
         with self.stats.lock:
@@ -993,6 +1220,8 @@ class InferenceEngine:
             # readback: work the device execution hid (the synchronous path
             # serializes exactly this span)
             self.stats.overlap_s += max(0.0, t0 - dispatched_at)
+        if kind == "spec":
+            return toks_np[:, :-1], toks_np[:, -1]
         return toks_np[0], toks_np[1]
 
     def pipeline_flush(self, count: bool = True) -> int:
@@ -1008,6 +1237,7 @@ class InferenceEngine:
         while self._pl_inflight:
             self.pipeline_consume()
         self._pl_carry = None
+        self._pl_carry_pos = None
         if n and count:
             with self.stats.lock:
                 self.stats.pipeline_flushes += 1
@@ -1028,6 +1258,7 @@ class InferenceEngine:
         n = len(self._pl_inflight)
         self._pl_inflight.clear()
         self._pl_carry = None
+        self._pl_carry_pos = None
         if n:
             with self.stats.lock:
                 self.stats.pipeline_flushes += 1
@@ -1091,6 +1322,177 @@ class InferenceEngine:
             self.stats.spec_steps += 1
             self.stats.sync_bytes_total += self.stats.sync_bytes_per_decode
         return logits, emitted, n_emit
+
+    # pod roots broadcast in-chain spec verify steps as
+    # OP_DECODE_SPEC_PIPELINED / OP_DECODE_SPEC_PREFILL_FUSED packets
+    supports_spec_pipelined = True
+
+    def check_spec_drafts(self, drafts) -> None:
+        """THE draft-shape contract, in one place: every spec-pipelined
+        entry point (engine dispatch, fused variant, and the pod root's
+        pre-broadcast validation) calls this, so a future layout change
+        cannot silently diverge one copy from the others."""
+        shape = getattr(drafts, "shape", None)
+        want = (self.n_lanes, self.SPEC_DRAFT + 1)
+        if shape != want:
+            raise ValueError(
+                f"spec drafts shape {shape} != {want} (SPEC_DRAFT + 1 "
+                "columns: candidate 0 is the host's guess at the carry "
+                "token itself)"
+            )
+
+    def check_spec_pipelined_dispatch(self, drafts, reseed: bool,
+                                      positions=None) -> None:
+        """``check_pipelined_dispatch`` plus the draft-shape contract —
+        the full pre-broadcast validation set for OP_DECODE_SPEC_PIPELINED
+        (a packet whose root-side compute raises desyncs the pod)."""
+        self.check_spec_drafts(drafts)
+        self.check_pipelined_dispatch(reseed, positions)
+
+    def decode_spec_pipelined(
+        self,
+        positions: np.ndarray,
+        drafts: np.ndarray,
+        draft_len: np.ndarray,
+        temps: np.ndarray | None = None,
+        topps: np.ndarray | None = None,
+        seeds: np.ndarray | None = None,
+        tokens: np.ndarray | None = None,
+    ) -> None:
+        """Dispatch ONE speculative verify step INTO the pipelined ring —
+        the zero-flush composition of ``decode_spec`` and
+        ``decode_pipelined``: up to SPEC_DRAFT host-shipped drafts are
+        verified against the device's own token carry inside the async
+        chain, the per-lane accepted counts advance the POSITION carry
+        (``pos + accepted + 1``), and the lagged readback packs
+        ``[n, K+1]`` emitted tokens + counts exactly like ``decode_spec``.
+        The chain never aborts for a draft hit.
+
+        ``drafts`` is ``[n, SPEC_DRAFT + 1]``: column 0 is the host's
+        candidate for the carry token itself (the host's n-gram index is
+        one step behind the device — the same lag the consume half already
+        models), verified on device before the remaining K count; on a
+        reseed the host knows the feed and ships it as candidate 0.
+        ``draft_len`` counts the real candidates INCLUDING column 0, so a
+        lane needs ``draft_len >= 2`` to possibly accept anything.
+        Position semantics are ``decode_pipelined``'s (-1 = device carry).
+        Consume via ``pipeline_consume``; junk steps racing a stop follow
+        the same discard rule as every pipelined step."""
+        n = self.n_lanes
+        if temps is None:
+            temps = np.zeros(n, np.float32)
+        if topps is None:
+            topps = np.full(n, DEFAULT_TOPP, np.float32)
+        if seeds is None:
+            seeds = np.zeros(n, np.uint32)
+        # drafts arrive as a host ndarray from the scheduler's n-gram probe
+        # (or the worker's packet slot view); shape-checked, never synced
+        self.check_spec_pipelined_dispatch(drafts, tokens is not None,
+                                           positions)
+        faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
+        feed, carry_pos = self._pl_feed(tokens, positions)
+        nxt, new_pos, packed, self.cache = self._decode_spec_pl_fn(
+            self.params,
+            self.cache,
+            feed,
+            carry_pos,
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(drafts, jnp.int32),
+            jnp.asarray(draft_len, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topps, jnp.float32),
+            jnp.asarray(seeds, jnp.uint32),
+        )
+        self._pl_carry = nxt
+        self._pl_carry_pos = new_pos
+        self._pl_inflight.append(("spec", packed, time.perf_counter()))
+        with self.stats.lock:
+            self.stats.pipeline_dispatches += 1
+            self.stats.spec_steps += 1
+            self.stats.spec_pipelined_steps += 1
+            self.stats.sync_bytes_total += self.stats.sync_bytes_per_decode
+            d = len(self._pl_inflight)
+            self.stats.pipeline_depth_hist[d] = (
+                self.stats.pipeline_depth_hist.get(d, 0) + 1
+            )
+
+    def decode_spec_prefill_fused(
+        self,
+        positions: np.ndarray,
+        drafts: np.ndarray,
+        draft_len: np.ndarray,
+        temps: np.ndarray | None = None,
+        topps: np.ndarray | None = None,
+        seeds: np.ndarray | None = None,
+        p_lane: int = 0,
+        chunk: list[int] | None = None,
+        p_start: int = 0,
+        p_temp: float = 0.0,
+        p_topp: float = DEFAULT_TOPP,
+        p_seed: int = 0,
+        tokens: np.ndarray | None = None,
+    ) -> None:
+        """``decode_spec_pipelined`` that ALSO consumes one bounded prompt
+        chunk for lane ``p_lane`` — the full zero-flush composition: an
+        admitting chunk and a spec verify step share one dispatch, so
+        speculation, fused admission, and pipelining multiply instead of
+        trading off. Contracts are the union of ``decode_prefill_fused``
+        (chunk bounds, boundary-token carry, junk-KV safety) and
+        ``decode_spec_pipelined`` (draft alignment, position carry); the
+        packed readback is ``[n+1, K+2]`` with the boundary greedy/sampled
+        pair in ``emitted[-1, :2]``."""
+        n = self.n_lanes
+        if temps is None:
+            temps = np.zeros(n, np.float32)
+        if topps is None:
+            topps = np.full(n, DEFAULT_TOPP, np.float32)
+        if seeds is None:
+            seeds = np.zeros(n, np.uint32)
+        # host ndarray from the probe/packet — shape-checked, never synced
+        self.check_spec_drafts(drafts)
+        self.check_fused_dispatch(chunk, p_start, tokens is not None,
+                                  positions)
+        faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
+        feed, carry_pos = self._pl_feed(tokens, positions)
+        bucket = self.bucket_for(len(chunk))
+        padded = np.zeros(bucket, np.int32)
+        padded[: len(chunk)] = chunk
+        nxt, new_pos, packed, self.cache = self._decode_spec_prefill_fn(
+            self.params,
+            self.cache,
+            feed,
+            carry_pos,
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(drafts, jnp.int32),
+            jnp.asarray(draft_len, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topps, jnp.float32),
+            jnp.asarray(seeds, jnp.uint32),
+            jnp.int32(p_lane),
+            jnp.asarray(padded),
+            jnp.int32(p_start),
+            jnp.int32(len(chunk)),
+            jnp.float32(p_temp),
+            jnp.float32(p_topp),
+            jnp.uint32(p_seed & 0xFFFFFFFF),
+        )
+        self._pl_carry = nxt
+        self._pl_carry_pos = new_pos
+        self._pl_inflight.append(("spec", packed, time.perf_counter()))
+        with self.stats.lock:
+            self.stats.pipeline_dispatches += 1
+            self.stats.fused_steps += 1
+            self.stats.spec_steps += 1
+            self.stats.spec_pipelined_steps += 1
+            self.stats.sync_bytes_total += self.stats.sync_bytes_per_decode
+            self.stats.prefill_tokens += len(chunk)
+            self.stats.fused_bucket_hist[bucket] = (
+                self.stats.fused_bucket_hist.get(bucket, 0) + 1
+            )
+            d = len(self._pl_inflight)
+            self.stats.pipeline_depth_hist[d] = (
+                self.stats.pipeline_depth_hist.get(d, 0) + 1
+            )
 
     def sample_token(
         self, logits_row, temp: float, topp: float, seed: int, pos: int
@@ -1248,6 +1650,17 @@ def warmup_engine(
         ):
             engine.decode_pipelined(z, tokens=z)
             engine.pipeline_flush()
+            spec_pl = bool(
+                spec and getattr(engine, "supports_spec_pipelined", False)
+            )
+            if spec_pl:
+                # the in-chain spec verify step: the first draft hit in a
+                # live chain must not eat an XLA compile
+                k1 = engine.SPEC_DRAFT + 1
+                engine.decode_spec_pipelined(
+                    z, np.zeros((n, k1), np.int32), z, tokens=z
+                )
+                engine.pipeline_flush()
             if getattr(engine, "supports_fused_prefill", False):
                 # the fused prefill+decode family compiles per bucket —
                 # without this, the FIRST admission into a live chain
@@ -1258,6 +1671,14 @@ def warmup_engine(
                         park, p_lane=0, chunk=[0] * bucket, tokens=z,
                     )
                     engine.pipeline_flush()
+                    if spec_pl:
+                        # admitting chunk + spec verify sharing a dispatch
+                        # compiles per bucket too
+                        engine.decode_spec_prefill_fused(
+                            park, np.zeros((n, k1), np.int32), z,
+                            p_lane=0, chunk=[0] * bucket, tokens=z,
+                        )
+                        engine.pipeline_flush()
     # pod roots: drop the replayed warmup traffic from worker counters too
     reset_workers = getattr(engine, "reset_worker_stats", None)
     if reset_workers is not None:
@@ -1300,6 +1721,12 @@ def warmup_engine(
         multi_step=multi_step,
         speculative=bool(
             spec and getattr(engine, "supports_speculative", False)
+        ),
+        # drafts verified INSIDE the pipelined chain (zero-flush serving)
+        spec_pipelined=bool(
+            pipelined
+            and spec
+            and getattr(engine, "supports_spec_pipelined", False)
         ),
         seq_len=engine.config.seq_len,
     )

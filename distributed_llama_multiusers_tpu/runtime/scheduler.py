@@ -204,12 +204,15 @@ class _Lane:
     drafter: NgramDraftIndex = field(default_factory=NgramDraftIndex)
 
 
-# The fused on-device sampler truncates to the top-`device_topk` logits
-# (engine.py _sample_lane) — exact whenever the nucleus fits in k, which a
-# near-1.0 top-p or a very high temperature can defeat (flat distributions
-# spread mass past any fixed k). Such requests fall back to the bit-exact
-# host Sampler (full-vocab xorshift semantics, one [vocab] f32 transfer per
-# token) instead of silently sampling a truncated distribution.
+# Historical routing boundary, kept for the sampler-parity test grid and
+# the docs: requests at/above these used to fall back to the host Sampler
+# because the old on-device sampler truncated to top-`device_topk` logits,
+# which a near-1.0 top-p or a very high temperature defeats. The device
+# sampler is now EXACT (full-vocab sort → cumsum → nucleus mask,
+# engine.py _sample_lane), so no request routes host-exact on numerics
+# grounds anymore — `host_sampling=True` (bit-exact reference xorshift
+# semantics, one [vocab] f32 transfer per token) is the only remaining
+# host-exact path, and steady-state serving never reads logits back.
 HOST_EXACT_TOPP = 0.99
 HOST_EXACT_TEMP = 1.5
 
@@ -397,6 +400,16 @@ class ContinuousBatchingScheduler:
             fused_prefill=self._fused_ok(),
             multi_step=self.multi_step,
             speculative=self.speculative,
+            # drafts verify INSIDE the chain only while the ring lag is
+            # <= 1 (the host's carry candidate aligns one step behind):
+            # true at the default depth 2; deeper rings trade in-chain
+            # speculation for extra overlap — surfaced here so the
+            # trade-off is visible in logs, not silent
+            spec_in_chain=bool(
+                self._spec_pl_ok()
+                and self.pipelined
+                and getattr(self.engine, "pipeline_depth", 0) == 2
+            ),
             prefix_min_tokens=self.prefix_min_tokens,
             queue_capacity=getattr(self.queue, "capacity", None),
             queue_timeout_s=self.deadlines.queue_timeout_s,
@@ -703,14 +716,13 @@ class ContinuousBatchingScheduler:
         lane.seed = (
             req.seed if req.seed is not None else fresh_seed()
         ) & 0xFFFFFFFF
-        lane.host_exact = self.host_sampling or (
-            req.temperature > 0.0
-            and (
-                req.topp >= HOST_EXACT_TOPP
-                or req.topp <= 0.0  # both samplers define <=0 as full-vocab
-                or req.temperature >= HOST_EXACT_TEMP
-            )
-        )
+        # the on-device sampler is full-vocab exact, so host-exact survives
+        # only as the host_sampling=True escape hatch (bit-exact reference
+        # xorshift streams); wide-nucleus/high-temp requests stay on device
+        lane.host_exact = self.host_sampling
+        if lane.host_exact and req.temperature > 0.0:
+            with self.engine.stats.lock:
+                self.engine.stats.host_exact_lanes += 1
         lane.sampler = Sampler(
             self.engine.config.vocab_size, req.temperature, req.topp, lane.seed
         )
@@ -869,11 +881,26 @@ class ContinuousBatchingScheduler:
             and getattr(self.engine, "pipeline_depth", 0) >= 2
         )
 
+    def _spec_pl_ok(self) -> bool:
+        """Speculation rides the pipelined chain (the zero-flush path): the
+        engine compiles the in-chain verify family and speculation is on.
+        When False (engine without the family, or speculative=False), the
+        pre-zero-flush behavior applies: a draft hit flushes to the
+        synchronous spec path."""
+        return (
+            self.speculative
+            and getattr(self.engine, "SPEC_DRAFT", 0) > 0
+            and getattr(self.engine, "supports_speculative", False)
+            and getattr(self.engine, "supports_spec_pipelined", False)
+        )
+
     def _drafts_pending(self, live: dict) -> bool:
         """Host-side probe: does any GENERATING greedy lane's history draft?
-        A hit is a pipeline flush condition — the spec path emits >1 token
-        per forward and wins. Lanes still mid-admission (their first token
-        not yet consumed) are skipped: their ``next_token`` is not set."""
+        A hit is a pipeline flush condition ONLY for engines without the
+        in-chain verify family (``_spec_pl_ok`` False) — there the sync
+        spec path emits >1 token per forward and wins. Lanes still
+        mid-admission (their first token not yet consumed) are skipped:
+        their ``next_token`` is not set."""
         spec_k = (
             getattr(self.engine, "SPEC_DRAFT", 0)
             if self.speculative
@@ -964,46 +991,94 @@ class ContinuousBatchingScheduler:
                 )
         return ok
 
-    def _pipeline_dispatch(self, live: dict, admitting: dict, pl_pos: dict,
-                           feed):
+    def _pipeline_dispatch(self, live: dict, admitting: dict, feed,
+                           spec_ok: bool = False):
         """Dispatch half of the pipelined loop: queue the next decode step
-        from host-side lane METADATA only — positions (the scheduler knows
-        each consumed step advances a live lane by exactly 1) and sampling
-        params — and, when an admitting lane has prompt chunks pending,
-        piggyback ONE bounded chunk for ONE lane (round-robin, the sync
-        ``_prefill_step`` rule) on the SAME dispatch via
-        ``engine.decode_prefill_fused``: the admission streams through the
-        live chain instead of flushing it, and an admitting iteration
-        costs one device dispatch, not a prefill dispatch plus a decode
-        dispatch. The tokens stay on device (``feed=None`` selects the
-        engine's carry); nothing in here may read a device value back, or
-        the whole overlap dies — machine-checked by dlint's pipeline-sync.
+        from host-side lane METADATA only — sampling params, the ``-1``
+        carried-position sentinel for live lanes (their write positions
+        ride the DEVICE carry: a spec verify step advances each lane by
+        its own accept count, which the host only learns one step behind),
+        and, when an admitting lane has prompt chunks pending, ONE bounded
+        chunk for ONE lane (round-robin, the sync ``_prefill_step`` rule)
+        piggybacked on the SAME dispatch via ``engine.decode_prefill_fused``.
 
-        Returns ``(lane_idx, lane, final, n_chunk)`` for a fused dispatch
-        (None for a plain one). Chunk bookkeeping — ``lane.pos``,
-        ``lane.pending``, ``_lane_kv`` — commits here at DISPATCH time: the chunk's KV
-        writes execute in dispatch order whether or not the step's outputs
-        are ever consumed, so the resident-KV map stays truthful even for
-        a request cancelled mid-prompt."""
+        When ``spec_ok`` (speculation rides the chain, no spec step
+        already in flight, ring lag <= 1), the dispatch also probes each
+        GENERATING greedy lane's n-gram index — a pure host-side lookup,
+        no device value is touched — and ships up to SPEC_DRAFT+1 draft
+        candidates with the dispatch (``engine.decode_spec_pipelined`` /
+        ``decode_spec_prefill_fused`` when a chunk rides too). Candidate 0
+        is the host's guess at the device's carry token (the index is one
+        step behind — the consume half's own lag); the device verifies it
+        before counting the rest, so a stale probe costs acceptance, never
+        correctness, and the chain NEVER flushes for a draft hit.
+
+        The tokens stay on device (``feed=None`` selects the engine's
+        carry); nothing in here may read a device value back, or the whole
+        overlap dies — machine-checked by dlint's pipeline-sync.
+
+        Returns ``(fused_info, spec_drafted)``: ``fused_info`` is
+        ``(lane_idx, lane, final, n_chunk)`` for a chunk-carrying dispatch
+        (None otherwise); ``spec_drafted`` is ``{lane_idx: True}`` for
+        lanes whose shipped drafts can accept (None for a non-spec step —
+        the consume half needs it to interpret the packed readback and to
+        scope the acceptance counters to drafted lanes). Chunk bookkeeping
+        — ``lane.pos``, ``lane.pending``, ``_lane_kv`` — commits here at
+        DISPATCH time: the chunk's KV writes execute in dispatch order
+        whether or not the step's outputs are ever consumed, so the
+        resident-KV map stays truthful even for a request cancelled
+        mid-prompt."""
         engine = self.engine
         n_lanes = engine.n_lanes
         seq_len = engine.config.seq_len
+        reseed = feed is not None
         # idle/finished lanes park at seq_len: the mode="drop" KV scatter
         # discards their junk writes (same rule as the sync loop). An
         # admitting lane parks there too — its REAL writes this step are
-        # the fused chunk's, not the decode half's.
+        # the fused chunk's, not the decode half's. Live lanes read the
+        # device position carry (-1) except on a reseed, where the ring is
+        # empty and the host's committed positions are exact.
         positions = np.full(n_lanes, seq_len, np.int32)
         temps = np.zeros(n_lanes, np.float32)
         topps = np.full(n_lanes, DEFAULT_TOPP, np.float32)
         seeds = np.zeros(n_lanes, np.uint32)
         for i, lane in live.items():
-            # a dispatch racing ahead of a not-yet-discovered length stop
-            # may overrun seq_len; clamp to the drop sentinel (its output
-            # is discarded at consume time anyway)
-            positions[i] = min(pl_pos[i], seq_len)
+            positions[i] = min(lane.pos, seq_len) if reseed else -1
             temps[i] = lane.request.temperature
             topps[i] = lane.request.topp
             seeds[i] = lane.seed
+        # draft probe (host-side n-gram lookup over committed history +
+        # the last known fed token; legal here by construction — dlint's
+        # pipeline-sync pins that nothing below syncs a device value)
+        drafts = draft_len = None
+        drafted: dict[int, bool] = {}
+        if spec_ok:
+            spec_k = engine.SPEC_DRAFT
+            for i, lane in live.items():
+                req = lane.request
+                if (
+                    req.state != RequestState.GENERATING
+                    or req.temperature != 0.0
+                    or seq_len - lane.pos - 1 <= 0
+                ):
+                    continue
+                nt = lane.next_token
+                if reseed:
+                    # ring empty: nt IS this dispatch's feed — ship it as
+                    # candidate 0 (the carry gate passes trivially)
+                    d = [nt] + lane.drafter.draft(nt, spec_k)
+                else:
+                    # one step behind: nt fed the in-flight step; its
+                    # output is the carry, so the probe's first
+                    # continuation IS the carry candidate
+                    d = lane.drafter.draft(nt, spec_k + 1)
+                if len(d) >= 2:  # candidate 0 alone cannot accept anything
+                    if drafts is None:
+                        drafts = np.zeros((n_lanes, spec_k + 1), np.int32)
+                        draft_len = np.zeros(n_lanes, np.int32)
+                    drafts[i, : len(d)] = d
+                    draft_len[i] = len(d)
+                    drafted[i] = True
         target = None
         if admitting:
             # round-robin over admitting lanes so several prompts make
@@ -1013,56 +1088,91 @@ class ContinuousBatchingScheduler:
             )
             self._prefill_rr = (target + 1) % n_lanes
         if target is None:
-            engine.decode_pipelined(positions, temps, topps, seeds,
-                                    tokens=feed)
-            return None
+            if drafts is None:
+                engine.decode_pipelined(positions, temps, topps, seeds,
+                                        tokens=feed)
+                return None, None
+            engine.decode_spec_pipelined(
+                positions, drafts, draft_len, temps, topps, seeds,
+                tokens=feed,
+            )
+            return None, drafted
         lane = admitting[target]
         req = lane.request
         chunk = lane.pending[: engine.max_chunk()]
-        engine.decode_prefill_fused(
-            positions, temps, topps, seeds,
-            p_lane=target, chunk=chunk, p_start=lane.pos,
-            p_temp=0.0 if lane.host_exact else req.temperature,
-            p_topp=req.topp, p_seed=lane.seed,
-            tokens=feed,
-        )
+        if drafts is None:
+            engine.decode_prefill_fused(
+                positions, temps, topps, seeds,
+                p_lane=target, chunk=chunk, p_start=lane.pos,
+                p_temp=0.0 if lane.host_exact else req.temperature,
+                p_topp=req.topp, p_seed=lane.seed,
+                tokens=feed,
+            )
+        else:
+            # the full composition: an admitting chunk and a spec verify
+            # step share one dispatch
+            engine.decode_spec_prefill_fused(
+                positions, drafts, draft_len, temps, topps, seeds,
+                p_lane=target, chunk=chunk, p_start=lane.pos,
+                p_temp=0.0 if lane.host_exact else req.temperature,
+                p_topp=req.topp, p_seed=lane.seed,
+                tokens=feed,
+            )
         lane.pos += len(chunk)
         lane.pending = lane.pending[len(chunk):]
         self._lane_kv[target].extend(chunk)  # committed: prefix-cacheable
-        return (target, lane, not lane.pending, len(chunk))
+        return (
+            (target, lane, not lane.pending, len(chunk)),
+            drafted if drafts is not None else None,
+        )
 
     def _pipeline_consume(self, live: dict, entry: tuple) -> None:
         """Consume half, one step behind: block on the oldest in-flight
         step's packed token readback and run the host work the synchronous
         loop does inline — stream decode, EOS/stop, cancel/budget checks —
         while the younger dispatches keep the device busy. ``entry`` is
-        ``(step_lanes, fused, t_dispatch)`` recorded AT DISPATCH TIME:
-        ``step_lanes`` pairs each live lane index with its lane OBJECT —
-        the identity check skips both lanes that finished at an earlier
-        consumed step AND lanes already reclaimed by a NEW request while
-        this step was still in flight (either way the column is junk, and
-        its in-flight KV writes die under the overwrite-before-readable
-        rule). ``fused`` is the dispatch half's ``(lane_idx, lane, final,
-        n_chunk)`` for a fused prefill+decode step, whose extra readback
-        column carries the chunk's boundary token pair: on the FINAL
-        chunk that token is the request's first generated token,
-        committed here exactly one step behind — the same point the
-        synchronous path would have read it. ``t_dispatch`` is the step's
-        dispatch stamp: the telemetry slice spans dispatch -> this lagged
-        readback, recorded HERE (the consume half) so the dispatch half
-        stays span-free (dlint pipeline-sync)."""
+        ``(step_lanes, fused, t_dispatch, spec_drafted)`` recorded AT
+        DISPATCH TIME: ``step_lanes`` pairs each live lane index with its
+        lane OBJECT — the identity check skips both lanes that finished at
+        an earlier consumed step AND lanes already reclaimed by a NEW
+        request while this step was still in flight (either way the
+        column is junk, and its in-flight KV writes die under the
+        overwrite-before-readable rule). ``fused`` is the dispatch half's
+        ``(lane_idx, lane, final, n_chunk)`` for a chunk-carrying step,
+        whose extra readback column (row, for a spec pack) carries the
+        chunk's boundary token pair: on the FINAL chunk that token is the
+        request's first generated token, committed here exactly one step
+        behind — the same point the synchronous path would have read it.
+        ``spec_drafted`` (None for a plain step) marks the step as a spec
+        verify: the readback is ``decode_spec``'s (emitted, n_emit) pack,
+        each live lane commits a VARIABLE-LENGTH accept — next_token + the
+        accepted drafts, exactly the sync spec path's feed sequence — and
+        drafted lanes feed the acceptance counters (consumed-only, and
+        only when the lane actually fed tokens: a lane cancelled mid-draft
+        must not count a lane-step with zero emitted, which would push the
+        bench acceptance ratio below its [1, K+1] class). ``t_dispatch``
+        is the step's dispatch stamp: the telemetry slice spans dispatch
+        -> this lagged readback, recorded HERE (the consume half) so the
+        dispatch half stays span-free (dlint pipeline-sync)."""
         wd = self.watchdog
         if wd is not None:
             wd.begin_step()
         try:
-            greedy_np, sampled_np = self.engine.pipeline_consume()
+            out_a, out_b = self.engine.pipeline_consume()
         finally:
             if wd is not None:
                 wd.step_done()
         self.breaker.record_success()
         now = time.monotonic()
-        step_lanes, fused, t_dispatch = entry
-        self.telemetry.on_pipelined_step(t_dispatch, fused)
+        step_lanes, fused, t_dispatch, spec_drafted = entry
+        is_spec = spec_drafted is not None
+        self.telemetry.on_pipelined_step(
+            t_dispatch, fused, kind="spec_pipelined" if is_spec else "pipelined"
+        )
+        if is_spec:
+            emitted, n_emit = out_a, out_b
+        else:
+            greedy_np, sampled_np = out_a, out_b
         for i, lane in step_lanes:
             if live.get(i) is not lane:
                 continue  # finished earlier (or lane reclaimed): junk column
@@ -1075,6 +1185,36 @@ class ContinuousBatchingScheduler:
                 self.budget_timeouts += 1
                 self._finish(i, req, reason="timeout")
                 live.pop(i)
+                continue
+            if is_spec:
+                # variable-length commit: next_token + the accepted drafts
+                # (the plain-decode stream, per the verification identity);
+                # the model's token after the accepted prefix becomes the
+                # new pending token — the sync spec path's rule verbatim
+                cnt = int(n_emit[i])
+                seq = [lane.next_token] + [
+                    int(t) for t in emitted[i, : cnt - 1]
+                ]
+                alive = True
+                n_fed = 0
+                for t in seq:
+                    n_fed += 1
+                    if not self._consume(i, lane, t):
+                        alive = False
+                        break
+                if spec_drafted.get(i) and n_fed:
+                    with self.engine.stats.lock:
+                        self.engine.stats.spec_lane_steps += 1
+                        self.engine.stats.spec_emitted += n_fed
+                        acc = cnt - 1  # the device's accept count
+                        self.engine.stats.spec_accept_hist[acc] = (
+                            self.engine.stats.spec_accept_hist.get(acc, 0)
+                            + 1
+                        )
+                if not alive:
+                    live.pop(i)
+                    continue
+                lane.next_token = int(emitted[i, cnt - 1])
                 continue
             if not self._consume(i, lane, lane.next_token):
                 live.pop(i)
@@ -1094,11 +1234,18 @@ class ContinuousBatchingScheduler:
                 # GENERATING. The lane already joined the dispatch half's
                 # live set when its final chunk went out; the carry fed it
                 # on device, and the NEXT consumed step emits this token.
+                # Spec packs carry the boundary pair in the extra ROW's
+                # first two columns; token packs in the extra COLUMN.
                 req = lane.request
-                if req.temperature == 0.0:
-                    lane.next_token = int(greedy_np[-1])
+                if is_spec:
+                    b_greedy = int(emitted[-1, 0])
+                    b_sampled = int(emitted[-1, 1])
                 else:
-                    lane.next_token = int(sampled_np[-1])
+                    b_greedy = int(greedy_np[-1])
+                    b_sampled = int(sampled_np[-1])
+                lane.next_token = (
+                    b_greedy if req.temperature == 0.0 else b_sampled
+                )
                 req.state = RequestState.GENERATING
 
     def _run_pipelined(self, active) -> None:
@@ -1114,18 +1261,30 @@ class ContinuousBatchingScheduler:
         lane joins the decode half fed by the on-device carry — the chain
         never breaks and ``pipeline_flushes`` stays 0 under churn.
 
+        Speculation is part of steady state too (the zero-flush tentpole):
+        when the engine compiles the in-chain verify family
+        (``_spec_pl_ok``), a greedy lane whose history drafts ships its
+        candidates WITH the dispatch (``decode_spec_pipelined``, or the
+        chunk-carrying ``decode_spec_prefill_fused``) and the consume half
+        commits the variable-length accept one step behind — speculation's
+        extra tokens MULTIPLY with the overlap instead of aborting it.
+        Probing is gated to dispatches whose ring lag is <= 1 with no
+        other spec step in flight: past that the host's one-step-behind
+        carry candidate cannot align, so drafts would verify-and-miss
+        (correct but pointless).
+
         Exits by DRAINING the remaining in-flight steps through the normal
         consume path (their tokens are valid — no generated token is ever
         discarded for a live lane) when a flush condition appears: stop(),
-        a greedy lane whose history now drafts (the spec path emits >1
-        token per forward and wins), a host-exact admission (it reads full
-        logits every step, so the sync path must run it), a queued
-        admission with fused prefill OFF, or every lane finishing. An exit
-        with lanes still live counts as a pipeline flush in the engine
-        stats."""
+        a draft hit on an engine WITHOUT the in-chain verify family, a
+        host-exact admission (host_sampling mode reads full logits every
+        step, so the sync path must run it), a queued admission with fused
+        prefill OFF, or every lane finishing. An exit with lanes still
+        live counts as a pipeline flush in the engine stats."""
         engine = self.engine
         depth = max(2, int(getattr(engine, "pipeline_depth", 2)))
         fused = self._fused_ok()
+        spec_chain = self._spec_pl_ok()
         live: dict[int, _Lane] = dict(active)
         # lanes still streaming prompt chunks (sync-admitted leftovers on
         # entry; in-chain claims join via _claim_admissions)
@@ -1139,13 +1298,13 @@ class ContinuousBatchingScheduler:
                 # sync-admitted leftovers joining the chain: their
                 # remaining chunks ride fused dispatches too
                 self.telemetry.on_fused_admit(l.request)
-        # per-lane position of the NEXT dispatch = committed pos + in-flight
-        # lag (resynced from the lanes on every entry)
-        pl_pos = {i: lane.pos for i, lane in live.items()}
         feed = np.zeros(engine.n_lanes, np.int32)
         for i, lane in live.items():
             feed[i] = lane.next_token
-        meta: deque = deque()  # (live lanes, fused info) per dispatch
+        # (live lanes, fused info, dispatch stamp, spec-drafted set) per
+        # dispatch — positions no longer tracked host-side: they ride the
+        # device carry (spec accept counts are only known one step behind)
+        meta: deque = deque()
         host_feed = True  # first dispatch reseeds the chain from host tokens
         dispatched_any = False
         # both entry gates (_run's early fused entry and the post-spec
@@ -1195,7 +1354,9 @@ class ContinuousBatchingScheduler:
                     flush = not self._claim_admissions(admitting)
                 else:
                     flush = True
-            if not flush and probe_drafts:
+            if not flush and probe_drafts and not spec_chain:
+                # engines without the in-chain verify family: a draft hit
+                # still flushes to the synchronous spec path
                 flush = self._drafts_pending(live)
             probe_drafts = True  # entry gates probed already; re-check
             # from the second iteration on (new tokens land per consume)
@@ -1205,22 +1366,30 @@ class ContinuousBatchingScheduler:
                 # the step's trace slice (no tracer call — no lock, no
                 # sync — ever runs inside _pipeline_dispatch itself)
                 t_d = time.perf_counter()
-                fused_info = self._pipeline_dispatch(
-                    live, admitting, pl_pos, feed if host_feed else None
+                # spec drafts align only at ring lag <= 1 with no other
+                # spec step in flight (the host's carry candidate is one
+                # step behind — see _pipeline_dispatch)
+                spec_ok = (
+                    spec_chain
+                    and engine.pipeline_inflight() <= 1
+                    and not any(m[3] is not None for m in meta)
+                )
+                fused_info, spec_drafted = self._pipeline_dispatch(
+                    live, admitting, feed if host_feed else None, spec_ok
                 )
                 host_feed = False
                 dispatched_any = True
-                meta.append((tuple(live.items()), fused_info, t_d))
-                for i in live:
-                    pl_pos[i] += 1
+                meta.append(
+                    (tuple(live.items()), fused_info, t_d, spec_drafted)
+                )
                 if fused_info is not None and fused_info[2]:
                     # final chunk dispatched: the lane joins the decode
                     # half from the NEXT dispatch — the device carry holds
-                    # its first token, no host round-trip involved
+                    # both its first token AND its position (set by the
+                    # fused program), no host round-trip involved
                     i, lane, _, _ = fused_info
                     admitting.pop(i)
                     live[i] = lane
-                    pl_pos[i] = lane.pos
             if engine.pipeline_inflight() == 0:
                 break
             self._pipeline_consume(live, meta.popleft())
@@ -1389,7 +1558,12 @@ class ContinuousBatchingScheduler:
                 if (
                     active
                     and self._pipeline_ok(active)
-                    and not self._drafts_pending(dict(active))
+                    and (
+                        # drafts ride the chain when the engine verifies
+                        # in-chain; only legacy engines flush for them
+                        self._spec_pl_ok()
+                        or not self._drafts_pending(dict(active))
+                    )
                 ):
                     self._run_pipelined(active)
                     continue
@@ -1468,6 +1642,13 @@ class ContinuousBatchingScheduler:
             # on other lanes)
             spec_k = getattr(self.engine, "SPEC_DRAFT", 0)
             draft_len = None
+            if self._spec_pl_ok() and self._pipeline_ok(active, prefilled):
+                # drafts ride the chain: don't build the sync-path draft
+                # arrays just to discard them — the chain's dispatch half
+                # probes the SAME indices itself, with the carry-candidate
+                # layout the in-chain verify needs
+                self._run_pipelined(active)
+                continue
             if (
                 self.speculative
                 and spec_k > 0
@@ -1485,10 +1666,11 @@ class ContinuousBatchingScheduler:
                     draft_len = None  # nothing to verify: plain step
 
             if draft_len is None and self._pipeline_ok(active, prefilled):
-                # steady state with no drafts to verify: the pipelined path
-                # overlaps step k's host consume with step k+1's device
-                # execution (device-fed token carry, lagged readback) until
-                # an admission / draft / stop forces a flush
+                # steady state with no drafts to verify on a LEGACY engine
+                # (the in-chain-verify entry above handles the default):
+                # the pipelined path overlaps step k's host consume with
+                # step k+1's device execution (device-fed token carry,
+                # lagged readback)
                 self._run_pipelined(active)
                 continue
 
@@ -1524,11 +1706,11 @@ class ContinuousBatchingScheduler:
                     else ("multi" if h > 1 else "sync"),
                     t_step, args={"h": h} if h > 1 else None,
                 )
-                # host-exact lanes (global host_sampling mode, or
-                # per-request fallback for near-1.0 top-p / very high
-                # temperature where the device sampler's top-k truncation
-                # would distort): one batched [n_lanes, vocab] transfer;
-                # pure on-device batches: tokens only
+                # host-exact lanes (host_sampling=True only — the
+                # bit-exact reference-xorshift escape hatch; the device
+                # sampler is full-vocab exact, so no request routes here
+                # on numerics grounds): one batched [n_lanes, vocab]
+                # transfer; pure on-device batches: tokens only
                 logits_np = None
                 if host_exact_active:
                     # dlint: ok[host-sync] host-exact lanes only: ONE batched [n,vocab] f32 transfer, counted by all_logits

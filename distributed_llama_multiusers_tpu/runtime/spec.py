@@ -44,20 +44,55 @@ class NgramDraftIndex:
                 self._last[(g, tuple(self.hist[-g:]))] = len(self.hist) - g
 
     def draft(self, next_token: int, k: int) -> list[int]:
-        """Up to k draft tokens continuing (hist + [next_token]). The probe
-        gram ends at next_token, which is not yet committed, so a hit is
-        always a strictly earlier occurrence."""
+        """Up to k draft tokens continuing (hist + [next_token]). Each probe
+        gram ends at a not-yet-committed token, so a hit is always a
+        strictly earlier occurrence; the draft EXTENDS by re-probing over
+        the virtual tail (hist + next_token + tokens drafted so far), so a
+        short-period stream — whose last occurrence sits right at the end
+        of history and offers at most period-1 continuation tokens in one
+        lookup — still drafts the full k. (The pipelined chain needs this:
+        its carry-alignment gate spends one candidate, so single-token
+        probes could never accelerate a period-2 stream.)"""
         hist = self.hist
-        for g in sorted(self.GRAM_SIZES, reverse=True):
-            if len(hist) < g - 1:
-                continue
-            tail = (*hist[len(hist) - g + 1:], next_token)
-            j = self._last.get((g, tail))
-            if j is not None:
-                cont = hist[j + g : j + g + k]
-                if cont:
-                    return cont
-        return []
+        nh = len(hist)
+        # the VIRTUAL region: next_token + tokens drafted so far. Indexing
+        # spans (hist ++ virt) WITHOUT copying the history — the probe is
+        # O(k·gram), not O(history), and it runs per lane per dispatch.
+        virt = [next_token]
+
+        def at(i: int) -> int:
+            return hist[i] if i < nh else virt[i - nh]
+
+        # transient index over grams ending strictly before the current
+        # tail (so a probe can never match itself): a period-p stream's
+        # only earlier occurrence sits p tokens back, which is inside the
+        # virtual region after the first few drafts
+        overlay: dict = {}
+        gmax = sorted(self.GRAM_SIZES, reverse=True)
+        while len(virt) <= k:
+            total = nh + len(virt)
+            nxt = None
+            for g in gmax:
+                if total < g:
+                    continue
+                tail = tuple(at(total - g + j) for j in range(g))
+                j = overlay.get((g, tail))
+                if j is None:
+                    j = self._last.get((g, tail))
+                if j is not None and j + g < total:
+                    nxt = at(j + g)
+                    break
+            if nxt is None:
+                break
+            # the tail's own grams become legal matches once a token
+            # follows them — record them before appending
+            for g in self.GRAM_SIZES:
+                if total >= g:
+                    overlay[(g, tuple(at(total - g + j) for j in range(g)))] = (
+                        total - g
+                    )
+            virt.append(nxt)
+        return virt[1:]
 
 
 class SpecStream:
@@ -99,6 +134,10 @@ class SpecStream:
         # whether `pending` came from a spec verify (counts toward the
         # speculation acceptance stats) or a multi-step horizon (must not)
         self._pending_spec = False
+        # tokens already consumed from the CURRENT spec lookahead's verify
+        # step (seq[0] counts at verify time): discard_pending() needs it
+        # to retract a partially consumed step from the acceptance math
+        self._pending_consumed = 0
         self._toks = np.zeros(engine.n_lanes, np.int32)
         self._poss = np.zeros(engine.n_lanes, np.int32)
         self.last_logits = None  # batch logits of the last real forward
@@ -108,6 +147,31 @@ class SpecStream:
         if self.drafter is not None:
             for t in tokens:
                 self.drafter.append(int(t))
+
+    def discard_pending(self) -> None:
+        """Drop the unconsumed lookahead at a turn boundary (chat mode:
+        spec tokens drafted past EOS are uncommitted cache scribble the
+        next prefill overwrites — but the HOST-side buffer must go).
+
+        Accounting: a spec verify whose lookahead is only PARTIALLY
+        consumed is RETRACTED from the acceptance counters
+        (``spec_lane_steps`` / ``spec_emitted``), not left dangling — the
+        bench/stats acceptance ratio (emitted per drafted lane-step, class
+        [1, K+1]) aggregates only fully realized steps, so a turn ending
+        mid-lookahead can neither deflate it nor strand a lane-step whose
+        emitted count no longer means anything. Counters never go below 0
+        (a stats window reset between verify and discard clamps)."""
+        if self.pending and self._pending_spec:
+            stats = getattr(self.engine, "stats", None)
+            if stats is not None:
+                with stats.lock:
+                    stats.spec_lane_steps = max(0, stats.spec_lane_steps - 1)
+                    stats.spec_emitted = max(
+                        0, stats.spec_emitted - self._pending_consumed
+                    )
+        self.pending.clear()
+        self._pending_spec = False
+        self._pending_consumed = 0
 
     def flush_pipeline(self) -> None:
         """Flush any live async-decode chain before a direct engine call:
@@ -133,6 +197,7 @@ class SpecStream:
             if stats is not None and self._pending_spec:
                 with stats.lock:
                     stats.spec_emitted += 1  # lookahead token consumed NOW
+                self._pending_consumed += 1
             return self.pending.pop(0), False
         self.flush_pipeline()  # about to touch the engine directly
         draft: list[int] = []
@@ -154,6 +219,7 @@ class SpecStream:
             seq = [int(t) for t in em[0, : int(ne[0])]]
             self.pending = seq[1:]
             self._pending_spec = True
+            self._pending_consumed = 1  # seq[0] is consumed below
             # consumed-only accounting, same semantics as the scheduler's
             # loop: the tokens still in `pending` count when popped (and
             # never count if a turn ends and discards them)

@@ -209,6 +209,20 @@ class ApiServer:
                 round(stats["spec_emitted"] / stats["spec_lane_steps"], 3)
                 if stats["spec_lane_steps"] else None
             ),
+            # zero-flush serving: spec verify steps dispatched INSIDE the
+            # pipelined ring, the device accept-count histogram (drafted
+            # lanes only; 0 = nothing survived the carry-alignment gate,
+            # SPEC_DRAFT = full acceptance), and lanes routed through the
+            # host Sampler (host_sampling=True only — 0 in default
+            # serving, where the on-device sampler is full-vocab exact).
+            # /metrics carries dllama_spec_accepted_total delta-fed from
+            # the spec_emitted field (telemetry/hub.bridge_stats).
+            "spec_pipelined_steps": stats["spec_pipelined_steps"],
+            "spec_accept_hist": {
+                str(k): v
+                for k, v in sorted(stats["spec_accept_hist"].items())
+            },
+            "host_exact_lanes": stats["host_exact_lanes"],
             # per-step collective traffic (mesh runs; 0 single-chip): the
             # static per-decode estimate, the collective count behind it,
             # and the cumulative payload accrued per decode-family

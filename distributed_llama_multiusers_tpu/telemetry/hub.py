@@ -117,7 +117,18 @@ class Telemetry:
             "(dispatch/consume/transfer raise, contained), request "
             "(per-request input error), watchdog (stalled step)",
         )
+        # zero-flush serving: speculation acceptance as a native counter
+        # next to the dllama_stats_spec_* gauges the bridge republishes —
+        # delta-fed from the /stats spec_emitted field (same recipe as
+        # dllama_sync_bytes_total) so counter semantics survive
+        # engine.stats.reset() windows
+        self.spec_accepted = reg.counter(
+            "dllama_spec_accepted_total",
+            "tokens consumed from speculative verify steps on DRAFTED "
+            "lanes (the /stats spec_emitted field, delta-fed)",
+        )
         self._sync_bytes_seen = 0
+        self._spec_emitted_seen = 0.0
         self._failures_seen: dict[str, float] = {}
 
     # -- queue binding -------------------------------------------------------
@@ -210,22 +221,31 @@ class Telemetry:
         self.tracer.slice(f"step.{kind}", "pipeline", t0, now_pc, args=args)
         self.step_duration.observe(max(0.0, now_pc - t0))
 
-    def on_pipelined_step(self, t_dispatch: float, fused_info=None) -> None:
+    def on_pipelined_step(self, t_dispatch: float, fused_info=None,
+                          kind: str = "pipelined") -> None:
         """One pipelined step, recorded at CONSUME time (one step behind):
-        the slice spans dispatch -> lagged readback completion. For a
-        fused prefill+decode step, ``fused_info`` is the scheduler's
+        the slice spans dispatch -> lagged readback completion. ``kind``
+        distinguishes the in-chain spec verify steps
+        (``"spec_pipelined"`` — the zero-flush speculation path) from
+        plain pipelined decodes on the trace. For a fused prefill+decode
+        step, ``fused_info`` is the scheduler's
         ``(lane_idx, lane, final, n_chunk)`` and the admitting lane also
         gets a ``prefill.fused`` slice on its own track."""
         now_pc = self.tracer.now()
         if fused_info is None:
-            self.tracer.slice("step.pipelined", "pipeline", t_dispatch,
+            self.tracer.slice(f"step.{kind}", "pipeline", t_dispatch,
                               now_pc)
         else:
             lane_idx, lane, final, n_chunk = fused_info
             req = lane.request
             req_id = getattr(req, "id", None)
+            # a verify step that ALSO carries a chunk keeps its spec
+            # identity on the trace — the composition the zero-flush
+            # chain exists for must be countable, not folded into plain
+            # fused slices
+            name = "step.fused" if kind == "pipelined" else "step.spec_fused"
             self.tracer.slice(
-                "step.fused", "pipeline", t_dispatch, now_pc,
+                name, "pipeline", t_dispatch, now_pc,
                 req_id=req_id, args={"chunk": n_chunk, "final": final},
             )
             if req is not None:
@@ -367,6 +387,21 @@ class Telemetry:
                 self.sync_bytes.inc(float(total - self._sync_bytes_seen))
             # a drop means the stats window reset: re-baseline, counter keeps
             self._sync_bytes_seen = float(total)
+        # speculation acceptance: delta-fed like the sync-bytes counter,
+        # with one extra rule — spec_emitted can DIP without a window
+        # reset (SpecStream.discard_pending retracts a partially consumed
+        # verify step), and re-baselining downward would re-count the
+        # retracted tokens on the next rise. Keep the HIGH-WATER mark
+        # across a partial dip (the counter stays monotone; the retracted
+        # tokens remain counted — they really were consumed) and
+        # re-baseline only on a drop to 0 (engine.stats.reset()).
+        emitted = stats.get("spec_emitted")
+        if isinstance(emitted, (int, float)) and not isinstance(emitted, bool):
+            if emitted > self._spec_emitted_seen:
+                self.spec_accepted.inc(float(emitted - self._spec_emitted_seen))
+                self._spec_emitted_seen = float(emitted)
+            elif emitted == 0:
+                self._spec_emitted_seen = 0.0
         # breaker exposition (serving/breaker.py): the state gauge tracks
         # breaker_state_code verbatim; the classified-failure counter is
         # delta-fed from the engine_failures dict, same recipe as above

@@ -110,9 +110,21 @@ class MockAsyncEngine:
     supports_speculative = False
     supports_pipelined = True
     supports_fused_prefill = True
+    supports_spec_pipelined = False
+    SPEC_DRAFT = 3
 
     def __init__(self, n_lanes=4, vocab=64, seq_len=4096, step_s=0.002,
-                 pipeline_depth=2, max_chunk=16):
+                 pipeline_depth=2, max_chunk=16, speculative=False):
+        """``speculative=True`` opts this instance into the speculative
+        families (``decode_spec`` + the in-chain
+        ``decode_spec_pipelined`` / ``decode_spec_prefill_fused``),
+        mirroring the real engine's verify semantics over the
+        deterministic f(lane, pos) token function — drafts genuinely
+        accept whenever the scheduler's n-gram index predicts the
+        stream's own periodicity, so zero-flush speculation is testable
+        without accelerator noise. Off by default: pre-existing mock
+        tests pin non-speculative behavior."""
+        import numpy as np
         import types
 
         from ..runtime.engine import EngineStats
@@ -123,10 +135,20 @@ class MockAsyncEngine:
         self.pipeline_depth = pipeline_depth
         self.step_s = step_s
         self._max_chunk = max_chunk
+        self.supports_speculative = speculative
+        self.supports_spec_pipelined = speculative
         self._free_at = 0.0  # simulated device busy-until timestamp
-        # (ready_at, dispatched_at, step_idx, positions copy, boundary|None)
+        # (ready_at, dispatched_at, step_idx, kind, payload): payload is
+        # (toks, boundary|None) for "tok" steps, (emitted, n_emit) for
+        # "spec" steps — computed AT DISPATCH (the sim is deterministic),
+        # returned at consume like the real engine's lagged readback
         self._ring = []
         self._carry_live = False
+        # simulated device carry: each lane's next feed token + write
+        # position (the real engine's _pl_carry/_pl_carry_pos); a host
+        # position >= 0 overrides, -1 reads the carry — same contract
+        self._sim_tok = np.zeros(n_lanes, np.int64)
+        self._sim_pos = np.zeros(n_lanes, np.int64)
         self._steps = 0
         self.events = []  # ("dispatch"|"consume", step_idx)
 
@@ -173,6 +195,52 @@ class MockAsyncEngine:
         t = self._toks_at(positions)
         return None, t, t
 
+    def decode_spec(self, tokens, drafts, draft_len, positions, temps=None,
+                    topps=None, seeds=None):
+        """Synchronous speculative verify over the deterministic token
+        function: the real engine's acceptance rule (longest draft prefix
+        matching the model's own continuation) with greedy_j =
+        f(lane, pos + j)."""
+        import numpy as np
+
+        from . import faults
+
+        faults.fire("engine.dispatch")
+        now = time.monotonic()
+        self._free_at = max(now, self._free_at) + self.step_s
+        time.sleep(max(0.0, self._free_at - now))
+        self._steps += 1
+        emitted, n_emit = self._verify(
+            np.asarray(tokens), np.asarray(drafts), np.asarray(draft_len),
+            np.asarray(positions),
+        )
+        with self.stats.lock:
+            self.stats.decode_steps += 1
+            self.stats.spec_steps += 1
+        return None, emitted, n_emit
+
+    def _verify(self, tokens, drafts, draft_len, positions):
+        """The acceptance math shared by the sync and in-chain verify
+        mocks. drafts here are the K continuation candidates (the real
+        ``decode_spec`` layout)."""
+        import numpy as np
+
+        n = self.n_lanes
+        k = drafts.shape[1]
+        emitted = np.zeros((n, k + 1), np.int64)
+        n_emit = np.ones(n, np.int64)
+        seq_len = self.config.seq_len
+        for i in range(n):
+            pos = int(positions[i])
+            dlen = min(int(draft_len[i]), max(0, seq_len - pos - 1), k)
+            acc = 0
+            while acc < dlen and int(drafts[i, acc]) == self._tok(i, pos + acc):
+                acc += 1
+            n_emit[i] = acc + 1
+            for j in range(acc + 1):
+                emitted[i, j] = self._tok(i, pos + j)
+        return emitted, n_emit
+
     def pipeline_inflight(self):
         return len(self._ring)
 
@@ -180,16 +248,20 @@ class MockAsyncEngine:
     def pipeline_active(self):
         return bool(self._ring) or self._carry_live
 
-    def decode_pipelined(self, positions, temps=None, topps=None, seeds=None,
-                         tokens=None):
-        from . import faults
+    def _eff_positions(self, positions):
+        """The carried-position select: -1 reads the simulated device
+        carry, >= 0 overrides from host metadata."""
+        return [
+            int(self._sim_pos[i]) if int(p) < 0 else int(p)
+            for i, p in enumerate(positions)
+        ]
 
-        faults.fire("engine.dispatch")
+    def _push(self, kind, payload):
         now = time.monotonic()
         self._free_at = max(now, self._free_at) + self.step_s
         s = self._steps
         self._steps += 1
-        self._ring.append((self._free_at, now, s, list(positions), None))
+        self._ring.append((self._free_at, now, s, kind, payload))
         self._carry_live = True
         self.events.append(("dispatch", s))
         with self.stats.lock:
@@ -198,6 +270,18 @@ class MockAsyncEngine:
             self.stats.pipeline_depth_hist[d] = (
                 self.stats.pipeline_depth_hist.get(d, 0) + 1
             )
+
+    def decode_pipelined(self, positions, temps=None, topps=None, seeds=None,
+                         tokens=None):
+        from . import faults
+
+        faults.fire("engine.dispatch")
+        eff = self._eff_positions(positions)
+        toks = [self._tok(i, eff[i]) for i in range(self.n_lanes)]
+        for i in range(self.n_lanes):
+            self._sim_tok[i] = toks[i]
+            self._sim_pos[i] = min(eff[i] + 1, self.config.seq_len)
+        self._push("tok", (toks, None))
 
     def decode_prefill_fused(self, positions, temps=None, topps=None,
                              seeds=None, p_lane=0, chunk=None, p_start=0,
@@ -215,24 +299,106 @@ class MockAsyncEngine:
                 f"chunk of {len(chunk)} exceeds bucket {self._max_chunk}"
             )
         faults.fire("engine.dispatch")
-        now = time.monotonic()
-        self._free_at = max(now, self._free_at) + self.step_s
-        s = self._steps
-        self._steps += 1
+        eff = self._eff_positions(positions)
+        toks = [self._tok(i, eff[i]) for i in range(self.n_lanes)]
         boundary = self._tok(p_lane, p_start + len(chunk) - 1)
-        self._ring.append((self._free_at, now, s, list(positions), boundary))
-        self._carry_live = True
-        self.events.append(("dispatch", s))
+        for i in range(self.n_lanes):
+            self._sim_tok[i] = toks[i]
+            self._sim_pos[i] = min(eff[i] + 1, self.config.seq_len)
+        # the joined lane's carry = the boundary pair (real-engine rule)
+        self._sim_tok[p_lane] = boundary
+        self._sim_pos[p_lane] = p_start + len(chunk)
+        self._push("tok", (toks, boundary))
         with self.stats.lock:
-            self.stats.pipeline_dispatches += 1
             self.stats.fused_steps += 1
             self.stats.prefill_tokens += len(chunk)
             self.stats.fused_bucket_hist[self._max_chunk] = (
                 self.stats.fused_bucket_hist.get(self._max_chunk, 0) + 1
             )
-            d = len(self._ring)
-            self.stats.pipeline_depth_hist[d] = (
-                self.stats.pipeline_depth_hist.get(d, 0) + 1
+
+    def _spec_payload(self, positions, drafts, draft_len, tokens):
+        """The in-chain verify sim: resolve carry tok/pos, apply the
+        candidate-0 alignment gate, run the acceptance math, and advance
+        the simulated carry by the per-lane emit counts."""
+        import numpy as np
+
+        n = self.n_lanes
+        eff = self._eff_positions(positions)
+        carry = (
+            [int(t) for t in tokens] if tokens is not None
+            else [int(t) for t in self._sim_tok]
+        )
+        k1 = np.asarray(drafts).shape[1]  # SPEC_DRAFT + 1
+        if k1 != self.SPEC_DRAFT + 1:
+            raise ValueError(
+                f"spec drafts shape {np.asarray(drafts).shape} != "
+                f"{(n, self.SPEC_DRAFT + 1)}"
+            )
+        eff_drafts = np.asarray(drafts)[:, 1:]
+        eff_len = np.zeros(n, np.int64)
+        for i in range(n):
+            if int(draft_len[i]) > 0 and int(drafts[i][0]) == carry[i]:
+                eff_len[i] = int(draft_len[i]) - 1
+        emitted, n_emit = self._verify(
+            np.asarray(carry), eff_drafts, eff_len, np.asarray(eff),
+        )
+        for i in range(n):
+            cnt = int(n_emit[i])
+            self._sim_tok[i] = int(emitted[i, cnt - 1])
+            self._sim_pos[i] = min(eff[i] + cnt, self.config.seq_len)
+        return emitted, n_emit
+
+    def decode_spec_pipelined(self, positions, drafts, draft_len,
+                              temps=None, topps=None, seeds=None,
+                              tokens=None):
+        from . import faults
+
+        faults.fire("engine.dispatch")
+        emitted, n_emit = self._spec_payload(
+            positions, drafts, draft_len, tokens
+        )
+        self._push("spec", (emitted, n_emit))
+        with self.stats.lock:
+            self.stats.spec_steps += 1
+            self.stats.spec_pipelined_steps += 1
+
+    def decode_spec_prefill_fused(self, positions, drafts, draft_len,
+                                  temps=None, topps=None, seeds=None,
+                                  p_lane=0, chunk=None, p_start=0,
+                                  p_temp=0.0, p_topp=0.9, p_seed=0,
+                                  tokens=None):
+        """An admitting chunk and a spec verify sharing one dispatch —
+        the readback appends the boundary pair as an extra ROW
+        (emitted[-1, :2]), the real engine's spec-pack layout."""
+        import numpy as np
+
+        from . import faults
+
+        if not chunk:
+            raise ValueError("fused prefill needs a non-empty prompt chunk")
+        if len(chunk) > self._max_chunk:
+            raise ValueError(
+                f"chunk of {len(chunk)} exceeds bucket {self._max_chunk}"
+            )
+        faults.fire("engine.dispatch")
+        emitted, n_emit = self._spec_payload(
+            positions, drafts, draft_len, tokens
+        )
+        boundary = self._tok(p_lane, p_start + len(chunk) - 1)
+        self._sim_tok[p_lane] = boundary
+        self._sim_pos[p_lane] = p_start + len(chunk)
+        brow = np.zeros((1, emitted.shape[1]), np.int64)
+        brow[0, 0] = brow[0, 1] = boundary
+        emitted = np.concatenate([emitted, brow])
+        n_emit = np.concatenate([n_emit, np.ones(1, np.int64)])
+        self._push("spec", (emitted, n_emit))
+        with self.stats.lock:
+            self.stats.spec_steps += 1
+            self.stats.spec_pipelined_steps += 1
+            self.stats.fused_steps += 1
+            self.stats.prefill_tokens += len(chunk)
+            self.stats.fused_bucket_hist[self._max_chunk] = (
+                self.stats.fused_bucket_hist.get(self._max_chunk, 0) + 1
             )
 
     def pipeline_consume(self):
@@ -241,7 +407,7 @@ class MockAsyncEngine:
         from . import faults
 
         faults.fire("engine.consume")
-        ready_at, dispatched_at, s, positions, boundary = self._ring.pop(0)
+        ready_at, dispatched_at, s, kind, payload = self._ring.pop(0)
         t0 = time.monotonic()
         time.sleep(max(0.0, ready_at - t0))
         self.events.append(("consume", s))
@@ -249,7 +415,11 @@ class MockAsyncEngine:
             self.stats.decode_steps += 1
             self.stats.decode_s += max(0.0, ready_at - t0)
             self.stats.overlap_s += max(0.0, t0 - dispatched_at)
-        t = self._toks_at(positions)
+        if kind == "spec":
+            emitted, n_emit = payload
+            return emitted, n_emit
+        toks, boundary = payload
+        t = np.asarray(toks, np.int32)
         if boundary is not None:
             t = np.concatenate([t, np.asarray([boundary], np.int32)])
         return t, t

@@ -443,6 +443,113 @@ def test_pipeline_sync_covers_fused_dispatch(tmp_path):
     assert "pipeline-sync" not in checks_of(clean)
 
 
+def test_pipeline_sync_covers_spec_pipelined_dispatch(tmp_path):
+    """Zero-flush serving: the in-chain spec verify steps
+    (``decode_spec_pipelined`` / ``decode_spec_prefill_fused``) are
+    dispatch halves too — a host-sync construct inside them (reading the
+    accept counts eagerly is the tempting bug) re-serializes the chain
+    exactly when speculation was supposed to multiply with it."""
+    findings = run_on(tmp_path, {"runtime/engine.py": """
+        import numpy as np
+
+        class E:
+            def decode_spec_pipelined(self, positions, drafts, draft_len,
+                                      tokens=None):
+                nxt, packed, self.cache = self._decode_spec_pl_fn(
+                    positions, drafts
+                )
+                return np.asarray(packed)
+
+            def decode_spec_prefill_fused(self, positions, drafts,
+                                          draft_len, chunk=None,
+                                          tokens=None):
+                nxt, packed, self.cache = self._decode_spec_prefill_fn(
+                    positions, drafts
+                )
+                return int(packed)
+    """})
+    checks = [f.check for f in findings if f.check == "pipeline-sync"]
+    assert len(checks) == 2  # one per spec dispatch half
+    # the clean shape: host draft candidates go IN, the packed verify
+    # readback stays on device in the ring
+    clean = run_on(tmp_path / "clean", {"runtime/engine.py": """
+        import numpy as np
+
+        class E:
+            def decode_spec_pipelined(self, positions, drafts, draft_len,
+                                      tokens=None):
+                nxt, new_pos, packed, self.cache = self._decode_spec_pl_fn(
+                    positions, drafts, draft_len
+                )
+                self._pl_carry = nxt
+                self._pl_carry_pos = new_pos
+                self._pl_inflight.append(("spec", packed))
+    """})
+    assert "pipeline-sync" not in checks_of(clean)
+
+
+def test_pipeline_sync_draft_probe_branch_legal(tmp_path):
+    """The draft-probing branch of ``_pipeline_dispatch`` is a pure
+    host-side n-gram lookup — building candidate arrays from the lane's
+    committed history is legal; syncing a device value to 'improve' the
+    probe is a finding."""
+    clean = run_on(tmp_path, {"runtime/scheduler.py": """
+        import numpy as np
+
+        class Sched:
+            def _pipeline_dispatch(self, live, admitting, feed, spec_ok):
+                positions = np.full(4, 128, np.int32)
+                drafts = None
+                draft_len = None
+                for i, lane in live.items():
+                    positions[i] = -1
+                    d = lane.drafter.draft(lane.next_token, 4)
+                    if len(d) >= 2:
+                        if drafts is None:
+                            drafts = np.zeros((4, 4), np.int32)
+                            draft_len = np.zeros(4, np.int32)
+                        drafts[i, : len(d)] = d
+                        draft_len[i] = len(d)
+                if drafts is None:
+                    self.engine.decode_pipelined(positions, tokens=feed)
+                else:
+                    self.engine.decode_spec_pipelined(
+                        positions, drafts, draft_len, tokens=feed
+                    )
+    """})
+    assert "pipeline-sync" not in checks_of(clean)
+    # probing off a DEVICE value instead of host history: a finding
+    bad = run_on(tmp_path / "bad", {"runtime/scheduler.py": """
+        import numpy as np
+
+        class Sched:
+            def _pipeline_dispatch(self, live, admitting, feed, spec_ok):
+                carry = np.asarray(self.engine._pl_carry)
+                self.engine.decode_spec_pipelined(carry)
+    """})
+    assert "pipeline-sync" in checks_of(bad)
+
+
+def test_pipeline_sync_real_spec_dispatch_funcs_registered():
+    """Rot-guard: the REAL engine/scheduler still define every dispatch
+    half the check scopes, and the check's scope list names the spec
+    families — a rename without a scope update would silently un-lint
+    the zero-flush path."""
+    import distributed_llama_multiusers_tpu.analysis.pipeline_check as pc
+    from distributed_llama_multiusers_tpu.runtime.engine import (
+        InferenceEngine,
+    )
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    for name in ("decode_spec_pipelined", "decode_spec_prefill_fused"):
+        assert name in pc.PIPELINE_FUNCS
+        assert hasattr(InferenceEngine, name)
+    assert "_pipeline_dispatch" in pc.PIPELINE_FUNCS
+    assert hasattr(ContinuousBatchingScheduler, "_pipeline_dispatch")
+
+
 def test_pipeline_sync_mesh_native_dispatch(tmp_path):
     """The mesh-native dispatch path (pod serving): sharding constraints
     on the device token carry are pure trace-time annotations — no

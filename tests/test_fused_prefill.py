@@ -297,22 +297,43 @@ def test_scheduler_fused_stop_string_under_churn(loaded):
     assert len(pl[0]) < 24  # the stop really fired
 
 
-def test_scheduler_host_exact_admission_still_flushes(loaded):
-    """The one admission kind that still exits the chain: a wide-nucleus
-    request (host-exact sampler, full logits every step). The chain
-    flushes, the sync path serves it bit-exactly, and streams match the
-    synchronous scheduler for both lanes."""
+def test_scheduler_wide_nucleus_admission_rides_chain(loaded):
+    """A wide-nucleus admission (top_p = 1.0 — the old host-exact flush
+    class) samples on device with the exact full-vocab sampler now, so
+    its chunks ride fused dispatches like any other admission: zero
+    flushes, streams identical to the synchronous scheduler."""
     config, params, tok = loaded
 
     def reqs():
         return [
             Request(prompt="hello world", max_tokens=20, temperature=0.0),
             Request(prompt="other prompt", max_tokens=6, temperature=0.8,
-                    topp=1.0, seed=3),  # host-exact fallback
+                    topp=1.0, seed=3),  # wide nucleus: on-device exact
         ]
 
     base, _ = _run_sync(config, params, tok, reqs())
     pl, stats = _run_churn(config, params, tok, reqs())
+    assert pl == base
+    assert stats["pipeline_flushes"] == 0  # no flush class left for it
+    assert stats["host_exact_lanes"] == 0
+
+
+def test_scheduler_host_sampling_admission_still_flushes(loaded):
+    """host_sampling=True is the one admission kind that still exits the
+    chain (full logits every step): the chain flushes, the sync path
+    serves it bit-exactly, and streams match the synchronous scheduler
+    for both lanes."""
+    config, params, tok = loaded
+
+    def reqs():
+        return [
+            Request(prompt="hello world", max_tokens=20, temperature=0.0),
+            Request(prompt="other prompt", max_tokens=6, temperature=0.8,
+                    topp=0.9, seed=3),  # host Sampler escape hatch
+        ]
+
+    base, _ = _run_sync(config, params, tok, reqs(), host_sampling=True)
+    pl, stats = _run_churn(config, params, tok, reqs(), host_sampling=True)
     assert pl == base
     assert stats["pipeline_flushes"] >= 1  # the host-exact claim flushed
     assert stats["fused_steps"] == 0  # its chunks went through sync prefill

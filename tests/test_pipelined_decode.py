@@ -321,10 +321,12 @@ def test_scheduler_pipelined_with_speculation(loaded):
     assert stats["spec_steps"] > 0  # speculation still engaged
 
 
-def test_host_exact_lane_disables_pipeline(loaded):
-    """A host-exact sampling lane (top_p >= 0.99 fallback) reads full
-    logits every step: the gate must keep the whole batch on the
-    synchronous path."""
+def test_wide_nucleus_lane_rides_pipeline(loaded):
+    """A wide-nucleus lane (top_p = 1.0 — the old host-exact fallback
+    class) samples on device with the EXACT full-vocab sampler now, so it
+    rides the pipelined chain instead of disabling it: streams identical
+    to the synchronous path (same fold_in(seed, pos) draws), pipeline
+    engaged, zero host_exact lanes."""
     config, params, tok = loaded
 
     def reqs():
@@ -333,9 +335,31 @@ def test_host_exact_lane_disables_pipeline(loaded):
 
     base, _ = _run_requests(config, params, tok, reqs(), pipelined=False)
     out, stats = _run_requests(config, params, tok, reqs(), pipelined=True)
+    assert out == base  # on-device exact sampler stream either way
+    assert len(out[0]) >= 1
+    assert stats["pipeline_dispatches"] > 0  # the chain served it
+    assert stats["pipeline_flushes"] == 0
+    assert stats["host_exact_lanes"] == 0
+
+
+def test_host_sampling_mode_disables_pipeline(loaded):
+    """host_sampling=True (the bit-exact reference-xorshift escape hatch)
+    is the ONE remaining host-exact path: it reads full logits every step,
+    so the gate must keep the whole batch on the synchronous path."""
+    config, params, tok = loaded
+
+    def reqs():
+        return [Request(prompt="hello", max_tokens=6, temperature=0.8,
+                        topp=0.9, seed=3)]
+
+    base, _ = _run_requests(config, params, tok, reqs(), pipelined=False,
+                            host_sampling=True)
+    out, stats = _run_requests(config, params, tok, reqs(), pipelined=True,
+                               host_sampling=True)
     assert out == base  # bit-exact host sampler stream either way
     assert len(out[0]) >= 1
     assert stats["pipeline_dispatches"] == 0  # gate kept the sync path
+    assert stats["host_exact_lanes"] == 1
 
 
 def test_pipelined_overshoot_does_not_corrupt_prefix_reuse(loaded):

@@ -1,0 +1,171 @@
+"""Numerics parity: the EXACT on-device top-p sampler vs the host
+``Sampler`` (tokenizer/sampler.py) over a seeded (temperature, top_p) grid.
+
+PINNED NUMERICS CLASS (the contract this file enforces):
+
+- SUPPORT-EXACT: the device sampler's nucleus — full-vocab descending
+  sort, cumulative sum, keep while (csum - p) < top_p including the
+  crossing token — equals the host Sampler's exact nucleus for every
+  (temp, topp) in the grid, including topp <= 0 / >= 1 (both samplers
+  define those as full-vocab multinomial) and the old HOST_EXACT_TOPP /
+  HOST_EXACT_TEMP routing boundaries, which no longer route anywhere:
+  every draw from either sampler lands inside that set.
+- DISTRIBUTION: probabilities are the same f32 softmax on both sides;
+  empirical frequencies agree with the analytic distribution (loose
+  total-variation bound — this is a smoke bound, not a statistical
+  proof).
+- RNG STREAMS DIFFER BY CONSTRUCTION: fold_in(seed, pos) + categorical
+  on device vs xorshift64* on host — token-for-token equality between
+  the two samplers is NOT part of the class and is never asserted.
+  What IS asserted: the device draw is deterministic per (seed, pos),
+  so seeded serving runs reproduce, and the device sampler equals
+  itself across the sync/pipelined scheduler paths (pinned by the
+  stream-identity tests in test_pipelined_decode.py /
+  test_spec_pipelined.py).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from distributed_llama_multiusers_tpu.formats import load_model_header
+from distributed_llama_multiusers_tpu.models import load_params_from_m
+from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+from distributed_llama_multiusers_tpu.runtime.scheduler import (
+    HOST_EXACT_TEMP,
+    HOST_EXACT_TOPP,
+)
+from distributed_llama_multiusers_tpu.tokenizer.sampler import Sampler
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_model):
+    h = load_model_header(tiny_model["model"])
+    config, params = load_params_from_m(tiny_model["model"], h,
+                                        dtype=jnp.float32)
+    return InferenceEngine(config, params, n_lanes=1, prefill_buckets=(4,))
+
+
+def _logits(vocab, seed=11):
+    rng = np.random.default_rng(seed)
+    # well-separated values: no nucleus-boundary ties for f32-vs-f64
+    # cumsum order to disagree on (the documented edge of the class)
+    return rng.permutation(np.linspace(-4.0, 4.0, vocab)).astype(np.float32)
+
+
+def _host_nucleus(logits, temp, topp):
+    """The host Sampler's exact kept set (src/tokenizer.cpp:416-457
+    semantics): softmax, stable sort desc, keep through the first token
+    whose cumulative crosses topp; topp <= 0 / >= 1 keep everything."""
+    x = logits.astype(np.float32) / np.float32(temp)
+    x = x - x.max()
+    p = np.exp(x, dtype=np.float32)
+    p /= p.sum(dtype=np.float32)
+    if topp <= 0 or topp >= 1:
+        return set(np.nonzero(p > 0)[0].tolist()), p
+    order = np.argsort(-p, kind="stable")
+    csum = np.cumsum(p[order], dtype=np.float64)
+    over = np.nonzero(csum > topp)[0]
+    last = int(over[0]) if len(over) else len(order) - 1
+    return set(order[: last + 1].tolist()), p
+
+
+GRID = [
+    (0.2, 0.3),
+    (0.7, 0.9),
+    (0.8, 1.0),          # wide nucleus: full multinomial
+    (0.8, 0.0),          # topp <= 0: both samplers define as full-vocab
+    (0.8, -0.5),         # negative topp: same rule
+    (0.8, HOST_EXACT_TOPP),   # the old host-exact routing boundary
+    (HOST_EXACT_TEMP, 0.9),   # the old high-temp routing boundary
+    (2.0, 0.5),
+]
+
+
+def test_device_draws_stay_in_exact_nucleus(engine):
+    """Every device draw lands in the host Sampler's exact nucleus, for
+    every grid point — the support-exactness half of the pinned class
+    (the old top-k sampler violated this for wide nuclei, which is why
+    host-exact routing existed)."""
+    vocab = engine.config.vocab_size
+    logits = _logits(vocab)
+    for temp, topp in GRID:
+        nucleus, _ = _host_nucleus(logits, temp, topp)
+        draws = {
+            engine.sample_token(logits, temp, topp, seed, pos)
+            for seed in (1, 2, 3, 4, 5)
+            for pos in range(10)
+        }
+        assert draws <= nucleus, (
+            f"device draw outside the exact nucleus at temp={temp}, "
+            f"topp={topp}: {sorted(draws - nucleus)}"
+        )
+
+
+def test_host_draws_stay_in_same_nucleus(engine):
+    """The host Sampler's own draws land in the same analytic nucleus —
+    i.e. the set both samplers are being held to IS the host's."""
+    vocab = engine.config.vocab_size
+    logits = _logits(vocab)
+    for temp, topp in GRID:
+        nucleus, _ = _host_nucleus(logits, temp, topp)
+        s = Sampler(vocab, temp, topp, 42)
+        draws = {s.sample(logits) for _ in range(50)}
+        assert draws <= nucleus, (temp, topp, sorted(draws - nucleus))
+
+
+def test_device_sampler_deterministic_per_seed_pos(engine):
+    """Same (seed, pos) -> same token; different pos -> a fresh draw from
+    the same stream (fold_in). Seeded serving runs reproduce."""
+    logits = _logits(engine.config.vocab_size)
+    a = [engine.sample_token(logits, 0.9, 0.95, 123, p) for p in range(20)]
+    b = [engine.sample_token(logits, 0.9, 0.95, 123, p) for p in range(20)]
+    assert a == b
+    assert len(set(a)) > 1  # the position folds into the stream
+
+
+def test_device_temp0_equals_host_greedy(engine):
+    """temp == 0 is argmax on both sides — bit-equal, no RNG involved."""
+    logits = _logits(engine.config.vocab_size)
+    host = Sampler(engine.config.vocab_size, 0.0, 0.9, 7)
+    assert engine.sample_token(logits, 0.0, 0.9, 7, 0) == host.sample(logits)
+
+
+def test_device_frequencies_match_analytic_distribution(engine):
+    """Distributional half of the pinned class: empirical device
+    frequencies track the analytic f32-softmax nucleus distribution
+    (loose total-variation smoke bound over a narrow nucleus, where a
+    truncated sampler would be visibly wrong)."""
+    vocab = engine.config.vocab_size
+    logits = _logits(vocab)
+    temp, topp = 0.7, 0.9
+    nucleus, p = _host_nucleus(logits, temp, topp)
+    keep = np.zeros(vocab)
+    keep[list(nucleus)] = 1
+    q = p * keep
+    q /= q.sum()
+    n = 1200
+    counts = np.zeros(vocab)
+    for seed in range(n):
+        counts[engine.sample_token(logits, temp, topp, seed, seed % 7)] += 1
+    emp = counts / n
+    tv = 0.5 * np.abs(emp - q).sum()
+    assert tv < 0.12, f"total variation {tv:.3f} vs analytic nucleus dist"
+
+
+def test_wide_nucleus_tail_actually_reachable(engine):
+    """The regression the exact sampler fixes: at topp=1.0 every token
+    with meaningful mass is reachable — including tokens far past any
+    fixed top-k cutoff. (With vocab > 64 = the old device_topk default,
+    the truncated sampler could never emit rank-65+.)"""
+    vocab = engine.config.vocab_size
+    assert vocab > 64, "tiny model vocab must exceed the old top-k"
+    # near-flat logits at high temp: substantial mass beyond rank 64
+    logits = _logits(vocab)
+    ranks = np.argsort(-logits)
+    tail = set(ranks[64:].tolist())
+    hit_tail = any(
+        engine.sample_token(logits, 2.0, 1.0, seed, 0) in tail
+        for seed in range(200)
+    )
+    assert hit_tail, "no draw ever reached past the old top-64 truncation"

@@ -253,11 +253,12 @@ def test_collective_stats_hlo_parser():
     assert out["n_collectives"] == 3
 
 
-def test_high_topp_requests_fall_back_to_host_sampler(tiny_model):
-    """top_p >= 0.99 / temp >= 1.5 defeat the device sampler's top-k
-    truncation, so those requests must route through the bit-exact host
-    Sampler (ADVICE r3: the default divergence needs a guard rail), while
-    ordinary sampled requests stay on-device (no [vocab] transfers)."""
+def test_high_topp_requests_stay_on_device(tiny_model):
+    """The on-device sampler is full-vocab EXACT (zero-flush serving), so
+    the old top-k-truncation fallback classes — top_p >= 0.99, temp >=
+    1.5 — sample on device like everyone else: ZERO logits transfers in
+    default serving. host_sampling=True remains the bit-exact host
+    Sampler escape hatch and still reads full-vocab logits per token."""
     import jax.numpy as jnp
 
     from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
@@ -288,11 +289,34 @@ def test_high_topp_requests_fall_back_to_host_sampler(tiny_model):
         on_device.future.result(timeout=300)
         assert fetches["n"] == 0, "ordinary sampled request transferred logits"
 
-        exact = Request(prompt="hello", max_tokens=4, temperature=0.8, topp=1.0, seed=3)
-        sched.submit(exact)
-        exact.future.result(timeout=300)
+        for wide_kw in ({"topp": 1.0}, {"topp": 0.0}, {"temperature": 1.8}):
+            wide = Request(prompt="hello", max_tokens=4, seed=3,
+                           **{"temperature": 0.8, **wide_kw})
+            sched.submit(wide)
+            wide.future.result(timeout=300)
+            assert wide.error is None and len(wide.generated_tokens) >= 1
+        assert fetches["n"] == 0, "wide-nucleus request transferred logits"
+        assert engine.stats.snapshot()["host_exact_lanes"] == 0
     finally:
         sched.stop()
+
+    # the escape hatch still reads full-vocab logits per sampled token
+    engine2 = InferenceEngine(config, params, n_lanes=2)
+    real2 = engine2.all_logits
+    engine2.all_logits = lambda logits: (
+        fetches.__setitem__("host", fetches.get("host", 0) + 1) or real2(logits)
+    )
+    sched2 = ContinuousBatchingScheduler(
+        engine2, Tokenizer(tiny_model["tokenizer"]), host_sampling=True
+    )
+    sched2.start()
+    try:
+        exact = Request(prompt="hello", max_tokens=4, temperature=0.8, topp=1.0, seed=3)
+        sched2.submit(exact)
+        exact.future.result(timeout=300)
+    finally:
+        sched2.stop()
     assert exact.error is None and len(exact.generated_tokens) >= 1
     # every sampled token (first included) came from full-vocab host logits
-    assert fetches["n"] >= len(exact.generated_tokens), fetches
+    assert fetches.get("host", 0) >= len(exact.generated_tokens), fetches
+    assert engine2.stats.snapshot()["host_exact_lanes"] == 1
