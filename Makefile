@@ -53,16 +53,20 @@ lint:
 dryrun:
 	python scripts/dryrun_multichip.py
 
-# Chaos gate (docs/SERVING.md "Failure containment & chaos testing"):
-# the deterministic fault-injection suite — engine faults contained
-# mid-churn with unaffected streams byte-identical, breaker
-# closed→open→half-open→closed over /health+/stats, watchdog firing on
-# a blackholed consume, fault-plan determinism, control-packet
-# integrity, and the HTTP bounded-wait 503. Mock-engine based: runs in
-# seconds, no accelerator. Run it before shipping scheduler/serving/
-# control-plane changes; the same tests ride tier-1 via `verify`.
+# Chaos gate (docs/SERVING.md "Failure containment & chaos testing" +
+# "Crash recovery & stream resumption"): the deterministic
+# fault-injection suite — engine faults contained mid-churn with
+# unaffected streams byte-identical, breaker closed→open→half-open→
+# closed over /health+/stats, watchdog firing on a blackholed consume,
+# fault-plan determinism, control-packet integrity, the HTTP
+# bounded-wait 503 — plus the crash-durability suite: kill the
+# scheduler mid-stream, recover from the journal, and every resumed
+# stream is byte-identical (zero lost / zero duplicated tokens).
+# Mock-engine based: runs in seconds, no accelerator. Run it before
+# shipping scheduler/serving/control-plane changes; the same tests ride
+# tier-1 via `verify`.
 chaos:
-	env JAX_PLATFORMS=cpu python -m pytest tests/test_failures.py -q
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_failures.py tests/test_journal.py -q
 
 # Reviewer aid for new lock/broadcast code (ROADMAP items 2-4): the
 # statically computed lock-order DAG, DOT on stdout (waived edges

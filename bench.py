@@ -30,6 +30,11 @@ single phase can eat the budget:
                fault injected mid-run (DLLAMA_FAULTS, utils/faults.py);
                reports error rate, hang-free, and breaker recovery time
                — the failure-containment layer's evidence
+  serving_recovery — the crash-durability gate: churn with the request
+               journal on, a simulated process death mid-stream, and a
+               --recover-journal restart; reports resume-latency-ms,
+               lost-token count (must be 0) and duplicate-token count
+               (must be 0) for clients reattaching via Last-Event-ID
   ablations  — packed Q40 via XLA dequant, dense bf16 (what the kernel buys)
   8b         — the BASELINE north star: Llama-3.1-8B Q40 decode tok/s vs
                200 tok/s/chip (BASELINE.md), now on by default
@@ -56,6 +61,8 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 from functools import partial
 
@@ -1075,6 +1082,222 @@ def _phase_serving_faults(config, small):
     }
 
 
+def _phase_serving_recovery(config, small):
+    """Crash-durability gate as a bench phase (ISSUE 10): the churn
+    arrival process with the JOURNAL on, a simulated process death
+    mid-stream, and a ``--recover-journal``-style restart. Reports what
+    the recovery layer is FOR:
+
+    - resume-latency-ms — recovery start -> first RESUMED delta reaching
+      a reattached client (the "latency blip" claim, measured);
+    - lost tokens (MUST be 0) — reference-stream tokens a client that
+      reconnected with its Last-Event-ID never saw;
+    - duplicate tokens (MUST be 0) — tokens delivered twice across the
+      kill.
+
+    The kill is a journal detach + abrupt stop, NOT an injected engine
+    fault: PR 8's containment layer CATCHES injected faults and journals
+    a finish (finish_reason="error") — by design, a contained failure is
+    final. Only a real process death leaves admit records without
+    finishes, so that is what the phase models (the same crash image a
+    watchdog ``os._exit(17)`` or an OOM kill leaves behind)."""
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.runtime.engine import warmup_engine
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+    from distributed_llama_multiusers_tpu.serving import (
+        RequestJournal,
+        StreamRegistry,
+        read_journal,
+        recover_scheduler,
+    )
+    from distributed_llama_multiusers_tpu.telemetry import Telemetry
+
+    n_lanes = 2 if small else 4
+    n_requests = n_lanes  # all lanes mid-flight at the kill
+    max_tokens = 24 if small else 64
+
+    class _RecoveryTokenizer(_BenchTokenizer):
+        """Per-token distinct text + prompt-dependent encoding, so
+        byte-identity across the kill is a REAL assertion (the base
+        bench tokenizer decodes every token to "x")."""
+
+        def encode(self, text, add_bos=True, add_special_tokens=True):
+            h = sum(ord(c) * (i + 1) for i, c in enumerate(text))
+            return [(h + 5 * i) % self.vocab_size for i in range(24)]
+
+        def decode(self, token):
+            return f"[{token}]"
+
+    def make_sched(journal):
+        params = _resident_packed_params(config)
+        engine = InferenceEngine(
+            config, params, n_lanes=n_lanes, prefill_buckets=(16,)
+        )
+        sched = ContinuousBatchingScheduler(
+            engine, _RecoveryTokenizer(config.vocab_size),
+            speculative=False, prefix_min_tokens=0, telemetry=Telemetry(),
+            journal=journal,
+        )
+        warmup_engine(engine, spec=False, multi_step=sched.multi_step)
+        return sched
+
+    def make_reqs():
+        return [
+            Request(
+                prompt=f"recovery benchmark prompt {i}",
+                max_tokens=max_tokens,
+                temperature=0.0 if i % 2 == 0 else 0.8,
+                seed=400 + i,
+            )
+            for i in range(n_requests)
+        ]
+
+    # -- reference: the uninterrupted streams --------------------------------
+    sched = make_sched(None)
+    refs = make_reqs()
+    ref_streams: dict[int, list] = {i: [] for i in range(n_requests)}
+
+    def ref_cb(i, rq):
+        return lambda d: ref_streams[i].append(
+            (len(rq.generated_tokens), d)
+        )
+
+    sched.start()
+    for i, rq in enumerate(refs):
+        rq.on_delta = ref_cb(i, rq)
+        sched.submit(rq)
+    for rq in refs:
+        rq.future.result(timeout=300)
+    sched.stop()
+
+    # -- crash run: journal on, die mid-stream -------------------------------
+    journal_path = os.path.join(
+        tempfile.mkdtemp(prefix="dllama_recovery_"), "journal.bin"
+    )
+    journal = RequestJournal(journal_path, progress_every=2, fsync=False)
+    sched = make_sched(journal)
+    crash = make_reqs()
+    pre: dict[int, list] = {i: [] for i in range(n_requests)}
+    delivered = {i: 0 for i in range(n_requests)}
+
+    def crash_cb(i, rq):
+        def cb(d):
+            pre[i].append((len(rq.generated_tokens), d))
+            delivered[i] = len(rq.generated_tokens)
+            journal.note_progress(rq.id, delivered[i])
+        return cb
+
+    rng = np.random.default_rng(17)
+    intervals = rng.exponential(0.02, n_requests)
+    sched.start()
+    for (i, rq), dt in zip(enumerate(crash), intervals):
+        time.sleep(dt)
+        rq.on_delta = crash_cb(i, rq)
+        sched.submit(rq)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and any(
+        len(v) < 4 for v in pre.values()
+    ):
+        time.sleep(0.005)
+    # the kill: nothing after this instant reaches the journal — the
+    # stop() below stands in for the process dying with lanes mid-decode
+    sched.journal = None
+    journal.flush()
+    journal.close()
+    sched.stop()
+    pre_tokens = sum(len(v) for v in pre.values())
+    incomplete = read_journal(journal_path).incomplete()
+
+    # -- restart + recovery --------------------------------------------------
+    registry = StreamRegistry(grace_s=60.0)
+    sched = make_sched(None)
+    sched.start()
+    t_recover = time.perf_counter()
+    coordinator = recover_scheduler(sched, journal_path, registry=registry)
+    first_delta_at: dict[int, float] = {}
+    resumed: dict[int, list] = {}
+
+    def reattach(i, rid, last):
+        got = registry.attach(rid)
+        if got is None:
+            return
+        _rq, relay, _kind, gen = got
+        out = []
+        while True:
+            item = relay.next_after(last, timeout=120, gen=gen)
+            if item is None:
+                break
+            if item[0] == "delta":
+                if i not in first_delta_at:
+                    first_delta_at[i] = time.perf_counter()
+                _, last, text = item
+                out.append((last, text))
+            elif item[0] == "done":
+                break
+            else:
+                break  # gap/superseded: recorded via lost-token count
+        resumed[i] = out
+
+    coordinator.join(240)
+    by_id = {rq.id: i for i, rq in enumerate(crash)}
+    threads = [
+        threading.Thread(
+            target=reattach, args=(by_id[e.request_id], e.request_id,
+                                   delivered[by_id[e.request_id]]),
+        )
+        for e in incomplete
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    sched.stop()
+    registry.close()
+
+    # -- reconcile: the client view vs the uninterrupted streams -------------
+    lost = dup = 0
+    identical = True
+    resume_ms = []
+    for i in range(n_requests):
+        view = pre[i] + resumed.get(i, [])
+        seen: dict[int, str] = {}
+        for idx, text in view:
+            if idx in seen:
+                dup += 1
+            seen[idx] = text
+        ref = dict(ref_streams[i])
+        lost += sum(1 for idx in ref if idx not in seen)
+        if "".join(t for _, t in sorted(seen.items())) != "".join(
+            t for _, t in sorted(ref.items())
+        ):
+            identical = False
+        if i in first_delta_at:
+            resume_ms.append((first_delta_at[i] - t_recover) * 1e3)
+    rec = coordinator.stats()
+    jstats = read_journal(journal_path)
+    return {
+        "serving_recovery_requests": n_requests,
+        "serving_recovery_killed_inflight": len(incomplete),
+        "serving_recovery_pre_kill_tokens": pre_tokens,
+        "serving_recovery_recovered_requests": rec["recovered_requests"],
+        "serving_recovery_replayed_tokens": rec["recovery_replayed_tokens"],
+        # the three headline properties of the recovery gate:
+        "serving_recovery_resume_latency_ms": (
+            round(min(resume_ms), 1) if resume_ms else None
+        ),
+        "serving_recovery_lost_tokens": lost,
+        "serving_recovery_duplicate_tokens": dup,
+        "serving_recovery_byte_identical": identical,
+        "serving_recovery_journal_records": jstats.records,
+        "serving_recovery_journal_torn_tail": jstats.torn,
+    }
+
+
 def _pipeline_microbench(n_requests=4, max_tokens=48):
     """Drive the REAL scheduler loop over the mocked async engine
     (utils.testing.MockAsyncEngine — the same stub the pinned tests in
@@ -1341,6 +1564,8 @@ def child_main() -> None:
         result = _phase_pod_serving(config, small)
     elif phase == "serving_faults":
         result = _phase_serving_faults(config, small)
+    elif phase == "serving_recovery":
+        result = _phase_serving_recovery(config, small)
     elif phase == "ablations":
         result = _phase_ablations(config, small)
     elif phase == "8b":
@@ -1498,7 +1723,7 @@ def main() -> None:
     # every phase after it (round 5) — order so a wedge costs nothing.
     for phase, cap in (
         ("serving", 420.0), ("serving_churn", 300.0), ("pod_serving", 300.0),
-        ("serving_faults", 240.0),
+        ("serving_faults", 240.0), ("serving_recovery", 240.0),
         ("8b", 500.0), ("ablations", 420.0), ("longctx", 300.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)

@@ -54,6 +54,11 @@ SCOPE = (
     # and the fault hooks sit inside the dispatch paths — none of them
     # may ever touch a device value
     "serving/breaker.py", "serving/watchdog.py", "utils/faults.py",
+    # crash durability rides it the same way: admit/finish records are
+    # enqueued from the serving loop, relay pushes run inside _consume,
+    # and recovery re-admits through submit() — all host-side by
+    # contract, never holding a device value
+    "serving/journal.py", "serving/recovery.py", "serving/resume.py",
 )
 CAST_SCOPE = ("runtime/engine.py",)
 

@@ -144,6 +144,31 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "death into a pod-wide signal while a silent "
                         "hang wedges everything). Default: "
                         "DLLAMA_STEP_DEADLINE env, else off (0)")
+    # crash-durable serving (serving/journal.py, serving/recovery.py,
+    # serving/resume.py; docs/SERVING.md "Crash recovery")
+    p.add_argument("--journal-path", default=None,
+                   help="serving: append-only CRC-framed request journal "
+                        "(crash durability) — admitted requests with "
+                        "their resolved sampler seeds plus periodic "
+                        "delivery watermarks, written by a background "
+                        "thread off the hot path. Off by default; pair "
+                        "with --recover-journal to resume after a crash")
+    p.add_argument("--recover-journal", action="store_true",
+                   help="serving: on startup, replay the --journal-path "
+                        "journal — every admitted-but-unfinished request "
+                        "is re-admitted and regenerated from its prompt "
+                        "with the same seed (byte-identical streams), "
+                        "fast-forwarded through its delivered-token "
+                        "watermark; re-admission is paced through the "
+                        "circuit breaker so recovery cannot stampede a "
+                        "freshly restarted engine")
+    p.add_argument("--reconnect-grace", type=float, default=0.0,
+                   help="serving: seconds a disconnected SSE client may "
+                        "reattach (GET /v1/stream/<id> with "
+                        "Last-Event-ID) before the request is cancelled; "
+                        "while the window is open the request keeps "
+                        "generating into a bounded delta buffer. 0 "
+                        "(default) preserves cancel-on-disconnect")
     # observability (telemetry/, docs/OBSERVABILITY.md)
     p.add_argument("--trace-path", default=None,
                    help="serving: write the request-lifecycle span ring as "

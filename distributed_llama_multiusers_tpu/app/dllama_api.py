@@ -21,15 +21,54 @@ def main(argv=None) -> None:
     scheduler = make_scheduler(engine, tokenizer, args)
     template_type = template_type_from_name(args.chat_template)
     model_name = os.path.basename(args.model or "dllama")
-    server = ApiServer(scheduler, tokenizer, model_name=model_name, template_type=template_type)
+    # resumable SSE (serving/resume.py): live with --reconnect-grace > 0;
+    # journal recovery registers its resumed streams here too
+    registry = None
+    grace = getattr(args, "reconnect_grace", 0.0) or 0.0
+    if grace > 0:
+        from ..serving import StreamRegistry
+
+        registry = StreamRegistry(grace_s=grace)
+        log("🔁", f"SSE reconnect grace: {grace:.0f}s "
+                  "(GET /v1/stream/<id> + Last-Event-ID)")
+    # crash recovery (serving/recovery.py): replay the journal's
+    # in-flight set through the normal admission path, paced behind the
+    # circuit breaker; resumed streams reattach through the registry
+    recovery = None
+    if getattr(args, "recover_journal", False) and args.journal_path:
+        from ..serving import recover_scheduler
+
+        recovery = recover_scheduler(
+            scheduler, args.journal_path, registry=registry
+        )
+        n = len(recovery.entries)
+        log("📓", f"Journal recovery: {n} incomplete request(s) replaying"
+                  + ("" if registry is not None or n == 0 else
+                     " (no --reconnect-grace: regenerating without "
+                     "stream reattach)"))
+    server = ApiServer(scheduler, tokenizer, model_name=model_name,
+                       template_type=template_type, resume=registry)
     httpd = server.serve(host=args.host, port=args.port)
     log("⭐", f"Server listening on {args.host}:{args.port} ({engine.n_lanes} lanes)")
 
-    def _shutdown(*_):
+    def _sigterm(*_):
+        # rolling-restart signal: flip /health + shed NEW submissions
+        # IMMEDIATELY (load balancers route away while the accept loop is
+        # still up), then stop the accept loop from a helper thread — the
+        # drain protocol in the finally below serves out in-flight work,
+        # flushes the journal, and sheds stragglers with retryable 503s.
+        # No out-of-band drain call needed: SIGTERM IS the drain trigger.
+        log("⭐", "SIGTERM: draining (health 503, admissions shedding)")
+        scheduler._draining.set()
+        # dlint: ok[condvar] shutdown() must come from another thread (serve_forever runs on THIS one) and returns once the accept loop stops; nothing joins a signal-handler helper
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    def _sigint(*_):
         log("⭐", "Shutting down")
         raise KeyboardInterrupt
 
-    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, _sigint)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
@@ -48,9 +87,17 @@ def main(argv=None) -> None:
         accept_loop.start()
         try:
             log("⭐", "Draining in-flight requests (30s window)")
+            if recovery is not None:
+                recovery.stop()  # no new replays into a draining server
             scheduler.drain(timeout=30.0)
         finally:
             httpd.shutdown()
+            if registry is not None:
+                registry.close()
+            if scheduler.journal is not None:
+                # drain() already flushed via stop(); close the writer
+                # and the file so the journal's tail is durable
+                scheduler.journal.close()
             if args.trace_path:
                 # the drained server's span ring as a Perfetto-loadable
                 # artifact (same document GET /trace served live)

@@ -243,6 +243,16 @@ def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
     overrides["watchdog_fatal"] = (
         getattr(engine, "_plane", None) is not None  # RootControlEngine
     )
+    # crash durability (serving/journal.py): the append-only request
+    # journal, off unless --journal-path names a file; recovery replay
+    # (--recover-journal) is wired by dllama_api after the scheduler is
+    # up, since stream reattach also needs the resume registry
+    jp = getattr(args, "journal_path", None)
+    if jp:
+        from ..serving import RequestJournal
+
+        overrides["journal"] = RequestJournal(jp)
+        log("📓", f"Request journal: {jp} (crash-durable serving)")
     # QoS surface (--max-queue / --queue-timeout / --request-budget):
     # bounded admission with per-user fair share, plus deadlines
     max_queue = getattr(args, "max_queue", 0) or 0

@@ -42,6 +42,7 @@ from ..serving import (
     DeadlinePolicy,
     Priority,
     QosQueue,
+    RequestJournal,
     StepWatchdog,
     budget_expired,
     drain_scheduler,
@@ -91,6 +92,27 @@ class RequestState(Enum):
 
 
 _req_ids = itertools.count(1)
+# guards the counter-object SWAP in ensure_request_id_floor against the
+# dataclass default_factory draws on HTTP threads: an unlocked
+# read-then-replace could let a fresh request draw from the old counter
+# an id the new counter re-issues later (two live requests, one id)
+_req_ids_lock = threading.Lock()
+
+
+def _next_request_id() -> int:
+    with _req_ids_lock:
+        return next(_req_ids)
+
+
+def ensure_request_id_floor(min_used_id: int) -> None:
+    """Advance the shared request-id counter past ``min_used_id`` —
+    recovery (serving/recovery.py) re-admits crashed requests under
+    their ORIGINAL ids (the SSE reattach key), and fresh requests
+    admitted after a recovery must never collide with them."""
+    global _req_ids
+    with _req_ids_lock:
+        nxt = next(_req_ids)
+        _req_ids = itertools.count(max(nxt, int(min_used_id) + 1))
 
 
 @dataclass
@@ -112,7 +134,14 @@ class Request:
     # per-request deadline overrides (serving/deadlines.py); None = policy
     queue_timeout_s: float | None = None
     budget_s: float | None = None
-    id: int = field(default_factory=lambda: next(_req_ids))
+    # crash-durable serving (serving/journal.py): which API route built
+    # this request ("chat" | "completion" | None) — journaled so a
+    # recovered stream renders the right SSE chunk shape on reattach —
+    # and whether this request IS a journal replay (re-admitted under
+    # its original id with its original resolved seed)
+    api_kind: str | None = None
+    recovered: bool = False
+    id: int = field(default_factory=_next_request_id)
     state: RequestState = RequestState.QUEUED
     future: Future = field(default_factory=Future)
     on_delta: Callable[[str], None] | None = None  # streaming callback
@@ -235,6 +264,7 @@ class ContinuousBatchingScheduler:
         breaker: CircuitBreaker | None = None,
         step_deadline_s: float | None = None,
         watchdog_fatal: bool = False,
+        journal: RequestJournal | None = None,
     ):
         """``host_sampling=True`` routes sampled lanes through the bit-exact
         host Sampler (reference xorshift semantics, one [vocab] f32 transfer
@@ -323,7 +353,17 @@ class ContinuousBatchingScheduler:
         single-host, crashing the process deliberately on a pod
         (``watchdog_fatal=True``) so ``jax.distributed`` peer-failure
         detection surfaces the hang. ``None`` reads
-        ``DLLAMA_STEP_DEADLINE``; 0 disables."""
+        ``DLLAMA_STEP_DEADLINE``; 0 disables.
+
+        ``journal`` (serving/journal.py): the crash-durable request
+        journal — every admission writes an admit record (prompt tokens,
+        sampler params with the RESOLVED seed, QoS class, deadlines) and
+        every ending a finish record, via the journal's background
+        writer thread; delivery watermarks are written by the transport
+        layer (server/http.py) AFTER each delta reaches the client. On
+        restart, serving/recovery.py replays the incomplete set
+        byte-identically. ``None`` (the default) disables journaling
+        entirely — the ``--journal-path`` flag wires one up."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.queue = queue_ or QosQueue()
@@ -367,6 +407,11 @@ class ContinuousBatchingScheduler:
         # engine-scoped containment rounds (loop thread writes, /stats
         # reads; single GIL-atomic int bump like the timeout counters)
         self.engine_failures = 0
+        # crash durability (serving/journal.py, serving/recovery.py):
+        # the request journal (None = off) and, after a --recover-journal
+        # restart, the replay coordinator whose counters /stats merges
+        self.journal = journal
+        self.recovery = None
         self._chat_stops = TokenizerChatStops(tokenizer)
         self._prefill_rr = 0  # round-robin cursor over admitting lanes
         # deadline enforcement counters (loop thread writes, /stats reads;
@@ -439,6 +484,10 @@ class ContinuousBatchingScheduler:
             self._thread = None
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.journal is not None:
+            # barrier, not close: the journal outlives scheduler restarts
+            # (its creator — runtime_setup / the test — owns closing it)
+            self.journal.flush()
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful shutdown (serving/drain.py): stop admitting — submit()
@@ -493,6 +542,34 @@ class ContinuousBatchingScheduler:
             note("draining")  # drain-shed load shows up in /stats too
         raise AdmissionRejected("draining", retry_after_s=5.0)
 
+    def build_recovered_request(self, entry) -> Request:
+        """Materialize a journal entry (serving/journal.JournalEntry)
+        back into a Request for deterministic replay — called by
+        serving/recovery.py, which stays runtime-free. The ORIGINAL
+        request id is kept (it is the SSE reattach key) and the fresh-id
+        counter advances past it so post-recovery admissions never
+        collide; the journaled RESOLVED seed rides in ``seed``, so the
+        lane re-derives the identical ``fold_in(seed, pos)`` stream the
+        crashed process was sampling."""
+        ensure_request_id_floor(entry.request_id)
+        return Request(
+            prompt=entry.prompt,
+            max_tokens=entry.max_tokens,
+            temperature=entry.temperature,
+            topp=entry.topp,
+            seed=entry.seed,
+            stop=list(entry.stop),
+            add_bos=entry.add_bos,
+            add_special_tokens=entry.add_special_tokens,
+            user_id=entry.user,
+            priority=entry.priority,
+            queue_timeout_s=entry.queue_timeout_s,
+            budget_s=entry.budget_s,
+            api_kind=entry.kind,
+            recovered=True,
+            id=entry.request_id,
+        )
+
     # -- internals ----------------------------------------------------------
 
     def _free_lane_indices(self) -> list[int]:
@@ -519,6 +596,15 @@ class ContinuousBatchingScheduler:
         out.update(self.breaker.stats())
         if self.watchdog is not None:
             out.update(self.watchdog.stats())
+        # crash durability: journal write accounting and — after a
+        # --recover-journal restart — the replay counters; every field
+        # is bridged to /metrics as a dllama_stats_* gauge (plus the
+        # delta-fed native counters in telemetry/hub.bridge_stats), so
+        # the two endpoints reconcile field-for-field
+        if self.journal is not None:
+            out.update(self.journal.stats())
+        if self.recovery is not None:
+            out.update(self.recovery.stats())
         stats = getattr(self.queue, "stats", None)
         if callable(stats):
             out.update(stats())
@@ -587,6 +673,11 @@ class ContinuousBatchingScheduler:
                 exc if exc is not None
                 else EngineFailure(error, request_id=req.id)
             )
+        if self.journal is not None:
+            # recorded after the future resolves, like _finish: a lost
+            # "error" finish record merely re-runs the request on
+            # recovery, which is always safe
+            self.journal.record_finish(req.id, "error")
 
     def _sweep_queue(self, now: float) -> None:
         """Resolve queued requests that expired or were cancelled while
@@ -731,6 +822,23 @@ class ContinuousBatchingScheduler:
             self.tokenizer.eos_token_ids, stops, self.eos_padding[0], self.eos_padding[1]
         )
         lane.decoder = self.tokenizer.make_stream_decoder()
+        if self.journal is not None:
+            # journaled LAST, with the RESOLVED seed (an unseeded request
+            # just drew OS entropy into lane.seed): everything a
+            # deterministic replay needs, and nothing is journaled for a
+            # request that failed tokenization above (no admit record ->
+            # nothing to resurrect). The call only enqueues — the
+            # journal's writer thread does the file I/O off this loop.
+            self.journal.record_admit(
+                request_id=req.id, prompt=req.prompt, tokens=list(tokens),
+                max_tokens=req.max_tokens, temperature=req.temperature,
+                topp=req.topp, seed=int(lane.seed), stop=list(req.stop),
+                add_bos=req.add_bos,
+                add_special_tokens=req.add_special_tokens,
+                user=req.user_id, priority=int(req.priority),
+                queue_timeout_s=req.queue_timeout_s, budget_s=req.budget_s,
+                stream=req.on_delta is not None, kind=req.api_kind,
+            )
 
     def _prefill_step(self) -> bool:
         """Advance ONE admitting lane by one prompt bucket (round-robin).
@@ -1416,6 +1524,18 @@ class ContinuousBatchingScheduler:
         self.telemetry.on_finish(req, lane_idx, reason)
         if not req.future.done():
             req.future.set_result(req.generated_text)
+        if self.journal is not None:
+            # a deliberate ending (stop/length/cancel/timeout) is final:
+            # the finish record keeps a later --recover-journal restart
+            # from resurrecting this request. A CRASH writes no finish
+            # records — that absence IS the journal's in-flight set.
+            # Recorded LAST — after the held-back tail delta and the
+            # future resolution — because the two crash windows are
+            # asymmetric: a finish record that never lands just re-runs
+            # the request on recovery (the client's Last-Event-ID filter
+            # dedups), while a finish record durable BEFORE the tail
+            # reached the transport would make the tail unrecoverable.
+            self.journal.record_finish(req.id, reason)
 
     def _run(self) -> None:
         """Supervised outer loop (failure containment, the ISSUE 8

@@ -22,17 +22,28 @@ carry the per-request summary (ttft_s, tbt p50/p95, queued_s, ...), and
 every error payload — 400/500 JSON and mid-stream SSE error events —
 names the ``request_id``, so a streamed failure correlates with the
 server's per-request JSON log line.
+
+Resumable SSE (crash-durable serving, docs/SERVING.md): every streamed
+delta carries its token index as the SSE ``id:`` line; with
+``--reconnect-grace`` > 0 a disconnected client reattaches within the
+window via ``GET /v1/stream/<request_id>`` + ``Last-Event-ID`` — to the
+live request (which kept generating into its bounded relay) or to one
+recovered from the request journal after a crash — and the stream
+resumes byte-identically. All shed Retry-After hints (queue full,
+breaker open, stalled-503) carry deterministic ±20% per-request jitter
+so a shed burst's synchronized retries cannot thundering-herd a
+recovering replica.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
-import queue
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..runtime.scheduler import Request
-from ..serving import AdmissionRejected
+from ..serving import AdmissionRejected, StreamRelay, jittered_retry_after
 from ..tokenizer import ChatItem, TemplateType, chat_generator_for
 from . import api_types
 
@@ -43,6 +54,11 @@ from . import api_types
 # scheduler — the failure mode the watchdog detects but cannot unblock —
 # can never hang a client socket forever.
 DEFAULT_RESULT_TIMEOUT_S = 600.0
+
+# Retry-After jitter keys for sheds with no request yet (a draining
+# submit that failed before a Request existed): a distinct key per shed
+# keeps even those spread across the ±20% band (serving/qos.py)
+_shed_keys = itertools.count(1)
 
 
 class SchedulerStalled(RuntimeError):
@@ -62,22 +78,34 @@ class SchedulerStalled(RuntimeError):
 class ApiServer:
     def __init__(self, scheduler, tokenizer, model_name: str = "dllama",
                  template_type: TemplateType = TemplateType.UNKNOWN,
-                 result_timeout_s: float = DEFAULT_RESULT_TIMEOUT_S):
+                 result_timeout_s: float = DEFAULT_RESULT_TIMEOUT_S,
+                 resume=None):
+        """``resume`` (serving/resume.StreamRegistry, built by dllama-api
+        when ``--reconnect-grace`` > 0): streamed requests register their
+        delta relay so a disconnected client can reattach within the
+        grace window (``GET /v1/stream/<id>`` + ``Last-Event-ID``) —
+        including streams recovered from the journal after a crash. None
+        (the default) preserves cancel-on-disconnect exactly."""
         self.scheduler = scheduler
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.chat_template = chat_generator_for(tokenizer, template_type)
         self.result_timeout_s = result_timeout_s
+        self.resume = resume
         self._httpd: ThreadingHTTPServer | None = None
         self._fallback_tel = None  # see _telemetry()
 
     # -- request handling ---------------------------------------------------
 
-    def _make_request(self, prompt: str, body: dict, streaming: bool) -> tuple[Request, "queue.Queue[str | None]"]:
+    def _make_request(self, prompt: str, body: dict, streaming: bool,
+                      kind: str | None = None) -> tuple[Request, StreamRelay | None]:
         """Shared Request construction for both routes (one place owns the
-        body->Request field mapping)."""
+        body->Request field mapping). Streaming requests get a
+        :class:`~..serving.resume.StreamRelay`: every delta is buffered
+        with its TOKEN INDEX (the SSE ``id:`` line), which is what makes
+        a stream resumable — the pump and any reconnecting client
+        address the stream by index, not by socket position."""
         params = api_types.InferenceParams.from_body(body)
-        deltas: "queue.Queue[str | None]" = queue.Queue()
         req = Request(
             prompt=prompt,
             max_tokens=params.max_tokens,
@@ -87,25 +115,40 @@ class ApiServer:
             stop=params.stop,
             user_id=params.user,
             priority=params.priority,
-            on_delta=(deltas.put if streaming else None),
+            api_kind=kind,
         )
-        return req, deltas
+        relay = None
+        if streaming:
+            if self.resume is not None:
+                relay = self.resume.register(req, kind=kind)
+            else:
+                # no reconnect semantics: unbounded (capacity 0), the
+                # pre-resume delta queue's exact behavior — a slow but
+                # connected client backpressures into memory, nothing
+                # is ever evicted out from under it
+                relay = StreamRelay(req.id, capacity=0)
+                req.future.add_done_callback(lambda _f: relay.finish())
+            # on_delta runs on the scheduler thread right after the token
+            # was consumed, so len(generated_tokens) IS the delta's
+            # token index
+            req.on_delta = lambda d: relay.push(len(req.generated_tokens), d)
+        return req, relay
 
-    def build_request(self, body: dict, streaming: bool) -> tuple[Request, "queue.Queue[str | None]"]:
+    def build_request(self, body: dict, streaming: bool) -> tuple[Request, StreamRelay | None]:
         """Validate the body and build the Request. Raises ValueError on bad
         input — callers must do this BEFORE committing response headers."""
         messages = api_types.parse_chat_messages(body)
         chat = self.chat_template.generate(
             [ChatItem(m.role, m.content) for m in messages], append_generation_prompt=True
         )
-        return self._make_request(chat.content, body, streaming)
+        return self._make_request(chat.content, body, streaming, kind="chat")
 
-    def build_completion_request(self, body: dict, streaming: bool) -> tuple[Request, "queue.Queue[str | None]"]:
+    def build_completion_request(self, body: dict, streaming: bool) -> tuple[Request, StreamRelay | None]:
         """/v1/completions: the raw prompt goes straight to the scheduler —
         no chat template. Beyond reference parity (the fork serves only
         the chat route, src/dllama-api.cpp:338-349)."""
         prompt = api_types.parse_completion_prompt(body)
-        return self._make_request(prompt, body, streaming)
+        return self._make_request(prompt, body, streaming, kind="completion")
 
     def handle_chat_completion(self, body: dict, send_chunk=None, prepared=None) -> dict:
         """Run a (pre-validated) request through the shared batching loop.
@@ -123,49 +166,26 @@ class ApiServer:
             api_types.completion_chunk_response, api_types.completion_response,
         )
 
-    def _run_request(self, req, deltas, send_chunk, chunk_fn, response_fn) -> dict:
+    def _run_request(self, req, relay, send_chunk, chunk_fn, response_fn) -> dict:
         if req.submitted_at is None:  # streaming pre-submits before headers
             self.scheduler.submit(req)
 
         if send_chunk:
-            req.future.add_done_callback(lambda _f: deltas.put(None))
             try:
-                while True:
-                    try:
-                        # bounded like the non-streaming wait below: the
-                        # gap between deltas is the streaming liveness
-                        # signal, and a wedged scheduler must become a
-                        # terminal error chunk, not a socket held open
-                        # forever
-                        delta = deltas.get(timeout=self.result_timeout_s)
-                    except queue.Empty:
-                        req.cancel()
-                        raise SchedulerStalled(
-                            req.id, self.result_timeout_s
-                        ) from None
-                    if delta is None:
-                        break
-                    send_chunk(chunk_fn(self.model_name, req.id, delta, False))
-                try:
-                    req.future.result()  # re-raise failures
-                except AdmissionRejected:
-                    # drain flushed this queued request after the SSE headers
-                    # were committed — too late for a 503 status line, so end
-                    # the stream with a terminal "cancelled" chunk instead
-                    req.finish_reason = "cancelled"
-                # terminal chunk carries the SAME per-request summary the
-                # non-streaming response does (one producer: the scheduler's
-                # telemetry finish hook), so stream clients are not blind
-                send_chunk(
-                    chunk_fn(
-                        self.model_name, req.id, None, True,
-                        req.finish_reason or "stop", summary=req.summary,
-                    )
-                )
+                self._pump(req, relay, relay.attach(), 0, send_chunk,
+                           chunk_fn)
             except (BrokenPipeError, ConnectionError, OSError):
-                # client went away: free the lane instead of generating to
-                # max_tokens into an orphaned queue
-                req.cancel()
+                if self.resume is not None:
+                    # reconnect-grace window: the request KEEPS generating
+                    # into its bounded relay; a client reattaching with
+                    # Last-Event-ID (GET /v1/stream/<id>) resumes
+                    # mid-stream, and the registry reaper cancels on
+                    # grace expiry if nobody returns
+                    self.resume.detach(req.id)
+                else:
+                    # default (grace 0): free the lane instead of
+                    # generating to max_tokens into an orphaned buffer
+                    req.cancel()
                 raise
             return {}
 
@@ -181,6 +201,79 @@ class ApiServer:
             self.model_name, req.id, text, req.n_prompt_tokens, len(req.generated_tokens),
             req.finish_reason or "stop", summary=req.summary,
         )
+
+    def _pump(self, req, relay, gen, after, send_chunk, chunk_fn) -> bool:
+        """Drain a stream's relay to one SSE consumer, starting after
+        token index ``after`` (0 for a fresh stream, the client's
+        Last-Event-ID on a reconnect). Every delta goes out with its
+        token index as the SSE ``id:`` line and — once it has reached
+        the client transport — advances the journal's delivery
+        watermark, so a crash recovers to a point the client had
+        actually seen. Returns True when the terminal chunk went out,
+        False on a quiet end (superseded by a newer consumer, or a
+        resume gap the client must restart from)."""
+        journal = getattr(self.scheduler, "journal", None)
+        while True:
+            item = relay.next_after(after, timeout=self.result_timeout_s,
+                                    gen=gen)
+            if item is None:
+                # bounded like the non-streaming wait: the gap between
+                # deltas is the streaming liveness signal, and a wedged
+                # scheduler must become a terminal error chunk, not a
+                # socket held open forever
+                req.cancel()
+                raise SchedulerStalled(req.id, self.result_timeout_s)
+            tag = item[0]
+            if tag == "delta":
+                _, idx, text = item
+                send_chunk(
+                    chunk_fn(self.model_name, req.id, text, False),
+                    event_id=idx,
+                )
+                after = idx
+                if journal is not None:
+                    # watermark AFTER the chunk reached the transport
+                    # (a diagnostics floor — recovery never discards by
+                    # it, since a socket write is not client receipt)
+                    journal.note_progress(req.id, idx)
+                continue
+            if tag == "superseded":
+                return False  # a reconnect took the stream over; unwind
+            if tag == "gap":
+                # deltas past this consumer's position were evicted from
+                # the bounded buffer: byte-identical resumption is
+                # impossible — fail closed rather than silently skip
+                send_chunk({
+                    "error": "resume window exceeded; restart the request",
+                    "reason": "resume_gap", "request_id": req.id,
+                })
+                if self.resume is not None:
+                    # a client that closes cleanly after this error chunk
+                    # raises no socket exception, so nothing else would
+                    # start the grace clock — without this the request
+                    # generates to max_tokens for nobody and its entry
+                    # only clears at natural finish plus a grace window
+                    self.resume.detach(req.id)
+                return False
+            break  # ("done",): the future resolved
+        try:
+            req.future.result()  # re-raise failures
+        except AdmissionRejected:
+            # drain flushed this queued request after the SSE headers
+            # were committed — too late for a 503 status line, so end
+            # the stream with a terminal "cancelled" chunk instead
+            req.finish_reason = "cancelled"
+        # terminal chunk carries the SAME per-request summary the
+        # non-streaming response does (one producer: the scheduler's
+        # telemetry finish hook), so stream clients are not blind
+        send_chunk(
+            chunk_fn(
+                self.model_name, req.id, None, True,
+                req.finish_reason or "stop", summary=req.summary,
+            ),
+            event_id=len(req.generated_tokens),
+        )
+        return True
 
     def handle_models(self) -> dict:
         return api_types.models_response(self.model_name)
@@ -268,6 +361,8 @@ class ApiServer:
         qos = getattr(sched, "qos_stats", None)
         if callable(qos):  # queue depth/wait/rejections, timeouts, drain
             out.update(qos())
+        if self.resume is not None:  # SSE reattach registry (resume.py)
+            out.update(self.resume.stats())
         tel = self._telemetry()
         if tel is not None:  # ring occupancy/eviction: a truncated /trace
             out.update(tel.tracer.counts())  # window is visible, not silent
@@ -330,14 +425,39 @@ class ApiServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _reject(self, e: AdmissionRejected):
-                # load shed: 429 (queue full) / 503 (draining), with a
-                # Retry-After hint so well-behaved clients back off
+            def _reject(self, e: AdmissionRejected, key: int | None = None):
+                # load shed: 429 (queue full) / 503 (draining/breaker),
+                # with a Retry-After hint so well-behaved clients back
+                # off — jittered ±20% per request (serving/qos.py) so a
+                # shed burst's synchronized retries don't thundering-herd
+                # the replica the moment it recovers
+                retry = jittered_retry_after(
+                    e.retry_after_s, key if key is not None else next(_shed_keys)
+                )
                 self._json(
                     e.http_status,
                     {"error": str(e), "reason": e.reason},
-                    headers={"Retry-After": str(max(1, round(e.retry_after_s)))},
+                    headers={"Retry-After": str(max(1, round(retry)))},
                 )
+
+            def _sse_headers(self):
+                self.send_response(200)
+                self._cors()
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+            def _sse_chunk(self, payload: dict, event_id=None):
+                # the `id:` line is the delta's TOKEN INDEX — what a
+                # reconnecting client echoes back as Last-Event-ID to
+                # resume the stream exactly where it left off
+                buf = b""
+                if event_id is not None:
+                    buf += f"id: {event_id}\n".encode()
+                buf += b"data: " + json.dumps(payload).encode() + b"\n\n"
+                self.wfile.write(buf)
+                self.wfile.flush()
 
             def do_OPTIONS(self):  # CORS preflight (dllama-api.cpp:228-236)
                 self.send_response(204)
@@ -348,6 +468,11 @@ class ApiServer:
             def do_GET(self):
                 if self.path == "/v1/models":
                     self._json(200, api.handle_models())
+                elif self.path.startswith("/v1/stream/"):
+                    # resumable SSE (serving/resume.py): reattach to a
+                    # live or journal-recovered stream by request id,
+                    # replaying from the client's Last-Event-ID
+                    self._resume_stream()
                 elif self.path == "/stats":
                     self._json(200, api.handle_stats())
                 elif self.path == "/metrics":
@@ -396,6 +521,58 @@ class ApiServer:
                 else:
                     self._json(404, {"error": "not found"})
 
+            def _resume_stream(self):
+                """GET /v1/stream/<request_id> + ``Last-Event-ID``: the
+                reconnect half of resumable SSE. 404s when resumption is
+                off (--reconnect-grace 0, the default), the id is
+                unknown, or the grace window expired."""
+                if api.resume is None:
+                    self._json(404, {
+                        "error": "stream resumption disabled "
+                                 "(--reconnect-grace is 0)",
+                    })
+                    return
+                try:
+                    rid = int(self.path.rsplit("/", 1)[1])
+                except ValueError:
+                    self._json(400, {"error": "bad stream id"})
+                    return
+                raw = self.headers.get("Last-Event-ID")
+                try:
+                    # no Last-Event-ID -> resume from the relay's base
+                    # (0 for recovered streams: without the client's own
+                    # position there is no safe skip point — the full
+                    # regenerated stream replays)
+                    after = None if raw is None else int(raw)
+                except ValueError:
+                    self._json(400, {"error": f"bad Last-Event-ID {raw!r}"})
+                    return
+                entry = api.resume.attach(rid)
+                if entry is None:
+                    self._json(404, {
+                        "error": "unknown or expired stream "
+                                 "(reconnect-grace window passed?)",
+                        "request_id": rid,
+                    })
+                    return
+                req, relay, kind, gen = entry
+                chunk_fn = (
+                    api_types.completion_chunk_response
+                    if kind == "completion"
+                    else api_types.chat_chunk_response
+                )
+                self._sse_headers()
+                try:
+                    api._pump(req, relay, gen,
+                              relay.base if after is None else after,
+                              self._sse_chunk, chunk_fn)
+                    self.wfile.write(b"data: [DONE]\n\n")
+                except (BrokenPipeError, ConnectionError, OSError):
+                    api.resume.detach(rid)  # gone again: restart the grace clock
+                except Exception as e:  # headers already out: SSE error event
+                    self._sse_chunk({"error": str(e), "request_id": rid})
+                    self.wfile.write(b"data: [DONE]\n\n")
+
             def do_POST(self):
                 routes = {
                     "/v1/chat/completions": (
@@ -434,47 +611,54 @@ class ApiServer:
                         # (queue full / draining) a proper 429/503
                         prepared = build_fn(body, streaming=True)
                         req = prepared[0]
-                        api.scheduler.submit(req)
                         try:
-                            self.send_response(200)
-                            self._cors()
-                            self.send_header("Content-Type", "text/event-stream")
-                            self.send_header("Cache-Control", "no-cache")
-                            self.send_header("Connection", "close")
-                            self.end_headers()
+                            api.scheduler.submit(req)
+                        except BaseException:
+                            # shed (breaker/queue/draining): the relay
+                            # was registered at build time, and nothing
+                            # will ever resolve this future or detach it
+                            # — drop the entry or the registry leaks one
+                            # per shed streaming POST
+                            if api.resume is not None:
+                                api.resume.discard(req.id)
+                            raise
+                        try:
+                            self._sse_headers()
                         except BaseException:
                             # client vanished between submit and the header
                             # commit: no pump will ever run, so cancel or the
                             # lane generates max_tokens into an orphaned queue
                             req.cancel()
                             raise
-
-                        def send_chunk(payload: dict):
-                            self.wfile.write(b"data: " + json.dumps(payload).encode() + b"\n\n")
-                            self.wfile.flush()
-
                         try:
-                            handle_fn(body, send_chunk=send_chunk, prepared=prepared)
+                            handle_fn(body, send_chunk=self._sse_chunk,
+                                      prepared=prepared)
                             self.wfile.write(b"data: [DONE]\n\n")
                         except (BrokenPipeError, ConnectionError, OSError):
-                            return  # client gone; request already cancelled
+                            # client gone; _run_request already cancelled
+                            # the request (or parked it in the resume
+                            # registry's grace window)
+                            return
                         except Exception as e:  # headers already sent: SSE error event
-                            send_chunk(err({"error": str(e)}))
+                            self._sse_chunk(err({"error": str(e)}))
                             self.wfile.write(b"data: [DONE]\n\n")
                     else:
                         prepared = build_fn(body, streaming=False)
                         req = prepared[0]
                         self._json(200, handle_fn(body, prepared=prepared))
                 except AdmissionRejected as e:  # shed before any headers
-                    self._reject(e)
+                    self._reject(e, key=req.id if req is not None else None)
                 except SchedulerStalled as e:
                     # wedged scheduler: retryable 503 naming the request
                     # (streamed variants surface as terminal SSE error
                     # chunks through the generic handler above — their
-                    # headers are already out)
+                    # headers are already out). Jittered like every shed.
+                    retry = jittered_retry_after(
+                        30.0, req.id if req is not None else next(_shed_keys)
+                    )
                     self._json(
                         503, err({"error": str(e), "reason": "stalled"}),
-                        headers={"Retry-After": "30"},
+                        headers={"Retry-After": str(max(1, round(retry)))},
                     )
                 except ValueError as e:
                     self._json(400, err({"error": str(e)}))

@@ -4,10 +4,13 @@ The substrate between the HTTP layer (server/http.py) and the
 continuous-batching loop (runtime/scheduler.py): qos.py owns who gets in
 and in what order, deadlines.py owns how long anything may wait or run,
 drain.py owns how the whole thing shuts down without dropping clients,
-breaker.py owns when a failing engine stops admitting at all, and
+breaker.py owns when a failing engine stops admitting at all,
 watchdog.py owns turning a hung step into a signal instead of a silent
-wedge. Imports nothing from runtime/ or server/ — it is a leaf both
-depend on.
+wedge, and the crash-durability trio — journal.py (append-only request
+journal), recovery.py (deterministic replay re-admission), resume.py
+(bounded delta relays for mid-stream SSE reattach) — owns making a
+process death a latency blip instead of data loss. Imports nothing from
+runtime/ or server/ — it is a leaf both depend on.
 """
 
 from .breaker import CircuitBreaker
@@ -19,5 +22,8 @@ from .deadlines import (
     queue_timeout_for,
 )
 from .drain import drain_scheduler
-from .qos import AdmissionRejected, Priority, QosQueue
+from .journal import JournalEntry, JournalImage, RequestJournal, read_journal
+from .qos import AdmissionRejected, Priority, QosQueue, jittered_retry_after
+from .recovery import RecoveryCoordinator, recover_scheduler
+from .resume import StreamRegistry, StreamRelay
 from .watchdog import StepWatchdog

@@ -112,6 +112,25 @@ def _default_cost(req) -> float:
     return float(max(1, getattr(req, "max_tokens", 1) or 1))
 
 
+def jittered_retry_after(seconds: float, key: int,
+                         spread: float = 0.2) -> float:
+    """``seconds`` with deterministic ±``spread`` jitter, floored at 1s.
+
+    Every shed path (breaker open, queue full, stalled-503) hands clients
+    a Retry-After; when a replica trips, it sheds a BURST of clients with
+    the SAME hint, and their synchronized retries land as a thundering
+    herd on the exact second the replica reopens — re-tripping it. The
+    jitter de-synchronizes the herd. Deterministic by design (a pure
+    splitmix64 hash of ``key`` — use the request id; the same finalizer
+    the fault plan's Bernoulli trigger uses): the same shed decision
+    always renders the same header, so tests and log correlation stay
+    exact, while distinct requests spread across the ±20% band."""
+    from ..utils.faults import _mix64
+
+    u = (_mix64(int(key) * 0x9E3779B97F4A7C15) >> 11) / float(1 << 53)
+    return max(1.0, float(seconds) * (1.0 - spread + 2.0 * spread * u))
+
+
 class QosQueue:
     """Priority + deficit-round-robin request queue with bounded admission.
 

@@ -127,8 +127,25 @@ class Telemetry:
             "tokens consumed from speculative verify steps on DRAFTED "
             "lanes (the /stats spec_emitted field, delta-fed)",
         )
+        # crash-durable serving (serving/journal.py, serving/recovery.py):
+        # journal writes and replay re-admissions as native counters next
+        # to the dllama_stats_* gauges the bridge republishes — delta-fed
+        # from the same /stats fields, so the endpoints reconcile while
+        # the counters keep Prometheus semantics across window resets
+        self.journal_records = reg.counter(
+            "dllama_journal_records_total",
+            "request-journal records durably written (the /stats "
+            "journal_records field, delta-fed)",
+        )
+        self.recovered_requests = reg.counter(
+            "dllama_recovered_requests_total",
+            "crashed requests re-admitted by journal replay (the /stats "
+            "recovered_requests field, delta-fed)",
+        )
         self._sync_bytes_seen = 0
         self._spec_emitted_seen = 0.0
+        self._journal_records_seen = 0.0
+        self._recovered_seen = 0.0
         self._failures_seen: dict[str, float] = {}
 
     # -- queue binding -------------------------------------------------------
@@ -402,6 +419,22 @@ class Telemetry:
                 self._spec_emitted_seen = float(emitted)
             elif emitted == 0:
                 self._spec_emitted_seen = 0.0
+        # crash durability: journal writes and recovery re-admissions,
+        # delta-fed with the sync-bytes recipe (monotone within a
+        # process; a drop to 0 means the journal/coordinator was swapped,
+        # re-baseline without re-counting)
+        for fld, ctr, seen_attr in (
+            ("journal_records", self.journal_records,
+             "_journal_records_seen"),
+            ("recovered_requests", self.recovered_requests,
+             "_recovered_seen"),
+        ):
+            v = stats.get(fld)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                seen = getattr(self, seen_attr)
+                if v > seen:
+                    ctr.inc(float(v - seen))
+                setattr(self, seen_attr, float(v))
         # breaker exposition (serving/breaker.py): the state gauge tracks
         # breaker_state_code verbatim; the classified-failure counter is
         # delta-fed from the engine_failures dict, same recipe as above

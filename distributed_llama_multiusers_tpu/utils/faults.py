@@ -18,6 +18,11 @@ call at each site, zero work when no plan is armed):
     engine.transfer   — all_logits / lane_logits (host transfers)
     plane.broadcast   — ControlPlane._send (root->worker packet out)
     plane.recv        — ControlPlane.recv (worker packet in)
+    journal.write     — RequestJournal writer-thread batch write (crash
+                        durability: a failed journal write is counted and
+                        contained, never fatal to serving)
+    recovery.replay   — RecoveryCoordinator per-entry re-admission
+                        (deterministic replay after a crash)
 
 Spec grammar (``DLLAMA_FAULTS`` env var, or :func:`arm` directly)::
 
@@ -59,6 +64,8 @@ POINTS = (
     "engine.transfer",
     "plane.broadcast",
     "plane.recv",
+    "journal.write",
+    "recovery.replay",
 )
 
 

@@ -114,7 +114,8 @@ class MockAsyncEngine:
     SPEC_DRAFT = 3
 
     def __init__(self, n_lanes=4, vocab=64, seq_len=4096, step_s=0.002,
-                 pipeline_depth=2, max_chunk=16, speculative=False):
+                 pipeline_depth=2, max_chunk=16, speculative=False,
+                 content_keyed=False):
         """``speculative=True`` opts this instance into the speculative
         families (``decode_spec`` + the in-chain
         ``decode_spec_pipelined`` / ``decode_spec_prefill_fused``),
@@ -123,7 +124,17 @@ class MockAsyncEngine:
         accept whenever the scheduler's n-gram index predicts the
         stream's own periodicity, so zero-flush speculation is testable
         without accelerator noise. Off by default: pre-existing mock
-        tests pin non-speculative behavior."""
+        tests pin non-speculative behavior.
+
+        ``content_keyed=True`` makes tokens a pure function of
+        (PROMPT CONTENT, position) instead of (lane, position): each
+        prefill folds its chunk into a per-lane stream key, so the same
+        request produces the same stream regardless of which lane it
+        lands on. That is the real engine's replay-determinism class
+        (sampling is per (seed, pos), greedy is per (model, prompt) —
+        never per lane), which the crash-recovery chaos tests pin: a
+        recovered request re-admitted onto a DIFFERENT lane must still
+        regenerate byte-identically."""
         import numpy as np
         import types
 
@@ -137,6 +148,8 @@ class MockAsyncEngine:
         self._max_chunk = max_chunk
         self.supports_speculative = speculative
         self.supports_spec_pipelined = speculative
+        self._content_keyed = content_keyed
+        self._lane_key = np.zeros(n_lanes, np.int64)
         self._free_at = 0.0  # simulated device busy-until timestamp
         # (ready_at, dispatched_at, step_idx, kind, payload): payload is
         # (toks, boundary|None) for "tok" steps, (emitted, n_emit) for
@@ -159,14 +172,33 @@ class MockAsyncEngine:
         pass
 
     def _tok(self, lane, pos):
-        # deterministic per (lane, position): stream identity across
-        # scheduler paths is checkable by simple equality
+        # deterministic per (lane, position) — or per (prompt-content
+        # key, position) in content_keyed mode: stream identity across
+        # scheduler paths / lane placements is checkable by equality.
+        # The keyed multiplier is 13, coprime to every small even
+        # vocab-2 modulus (31 shares a factor with the default 62 and
+        # would collapse the key to its parity).
+        if self._content_keyed:
+            key = int(self._lane_key[int(lane)])
+            return 2 + (key * 13 + int(pos) * 7) % (self.config.vocab_size - 2)
         return 2 + (int(lane) * 31 + int(pos) * 7) % (self.config.vocab_size - 2)
+
+    def _feed_key(self, lane, chunk, start_pos):
+        """content_keyed mode: fold a prefill chunk into the lane's
+        stream key (reset at a fresh prompt's first chunk), so the token
+        function depends on WHAT was prefilled, not WHERE."""
+        if not self._content_keyed:
+            return
+        k = 0 if start_pos == 0 else int(self._lane_key[int(lane)])
+        for t in chunk:
+            k = (k * 1000003 + int(t) + 1) & 0xFFFFFFFF
+        self._lane_key[int(lane)] = k
 
     def prefill_chunk(self, lane, chunk, start_pos, temp=0.0, topp=0.9, seed=0):
         from . import faults
 
         faults.fire("engine.dispatch")
+        self._feed_key(lane, chunk, start_pos)
         t = self._tok(lane, start_pos + len(chunk) - 1)
         with self.stats.lock:
             self.stats.prefill_tokens += len(chunk)
@@ -301,6 +333,7 @@ class MockAsyncEngine:
         faults.fire("engine.dispatch")
         eff = self._eff_positions(positions)
         toks = [self._tok(i, eff[i]) for i in range(self.n_lanes)]
+        self._feed_key(p_lane, chunk, p_start)
         boundary = self._tok(p_lane, p_start + len(chunk) - 1)
         for i in range(self.n_lanes):
             self._sim_tok[i] = toks[i]
@@ -384,6 +417,7 @@ class MockAsyncEngine:
         emitted, n_emit = self._spec_payload(
             positions, drafts, draft_len, tokens
         )
+        self._feed_key(p_lane, chunk, p_start)
         boundary = self._tok(p_lane, p_start + len(chunk) - 1)
         self._sim_tok[p_lane] = boundary
         self._sim_pos[p_lane] = p_start + len(chunk)

@@ -1041,11 +1041,19 @@ def test_real_lock_decls_are_collected():
         # failure containment (ISSUE 8): breaker/watchdog/fault-plan state
         # is lock-guarded and witness-wrapped like every other lock here
         "CircuitBreaker._lock", "StepWatchdog._lock", "FaultPlan._lock",
+        # crash durability (ISSUE 10): journal queue, resume relays, and
+        # recovery counters are lock-guarded and witness-wrapped too
+        "RequestJournal._lock", "StreamRelay._lock",
+        "StreamRegistry._lock", "RecoveryCoordinator._lock",
     ):
         assert qual in model.decls, f"lock declaration rotted: {qual}"
     assert model.canonical("QosQueue._not_empty") == "QosQueue._lock"
     # the watchdog condition is a view of its lock, same as the queue's
     assert model.canonical("StepWatchdog._cond") == "StepWatchdog._lock"
+    # the journal/relay/registry conditions fold into their locks too
+    assert model.canonical("RequestJournal._cv") == "RequestJournal._lock"
+    assert model.canonical("StreamRelay._cv") == "StreamRelay._lock"
+    assert model.canonical("StreamRegistry._cv") == "StreamRegistry._lock"
 
 
 def test_host_sync_covers_containment_files(tmp_path):
@@ -1063,6 +1071,85 @@ def test_host_sync_covers_containment_files(tmp_path):
                 "utils/faults.py"):
         findings = run_on(tmp_path / rel.replace("/", "_"), {rel: bad})
         assert checks_of(findings) == ["host-sync"], rel
+
+
+def test_crash_durability_files_in_all_scopes(tmp_path):
+    """ISSUE-10 satellite: serving/journal.py, serving/recovery.py and
+    serving/resume.py ride the serving loop (admit/finish records
+    enqueue from it, relay pushes run inside _consume, recovery
+    re-admits through submit()) — so they sit in the host-sync scope,
+    the package-wide clock ban, and the guarded-by discipline like the
+    containment files before them. Known-bad fixtures per check, plus
+    the clean shapes the real files use."""
+    sync_bad = """
+        import numpy as np
+
+        def record(journal, value):
+            journal.push(np.asarray(value))
+    """
+    clock_bad = """
+        import time
+
+        def stamp():
+            return time.time()
+    """
+    for rel in ("serving/journal.py", "serving/recovery.py",
+                "serving/resume.py"):
+        tag = rel.replace("/", "_")
+        findings = run_on(tmp_path / ("s_" + tag), {rel: sync_bad})
+        assert checks_of(findings) == ["host-sync"], rel
+        findings = run_on(tmp_path / ("c_" + tag), {rel: clock_bad})
+        assert checks_of(findings) == ["clock"], rel
+    # guarded-by: an unlocked touch of declared journal state is a
+    # finding; the locked touch is clean (the real writer's shape)
+    findings = run_on(tmp_path / "g", {"serving/journal.py": """
+        import threading
+
+        class RequestJournal:
+            _dlint_guarded_by = {("_lock",): ("_j_pending",)}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._j_pending = []
+
+            def bad_enqueue(self, rec):
+                self._j_pending.append(rec)
+
+            def good_enqueue(self, rec):
+                with self._lock:
+                    self._j_pending.append(rec)
+    """})
+    assert checks_of(findings) == ["guarded-by"]
+    assert "_j_pending" in findings[0].message
+    # known-good: monotonic waits + locked state, the real files' idiom
+    clean = run_on(tmp_path / "ok", {"serving/resume.py": """
+        import threading
+        import time
+
+        class StreamRelay:
+            _dlint_guarded_by = {("_lock", "_cv"): ("_rl_deltas",)}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._rl_deltas = []
+
+            def push(self, index, text):
+                with self._cv:
+                    self._rl_deltas.append((index, text))
+                    self._cv.notify_all()
+
+            def wait_next(self, timeout):
+                deadline = time.monotonic() + timeout
+                with self._cv:
+                    while not self._rl_deltas:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return None
+                        self._cv.wait(remaining)
+                    return self._rl_deltas[0]
+    """})
+    assert clean == []
 
 
 # -- lock-blocking ------------------------------------------------------------

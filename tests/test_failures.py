@@ -381,6 +381,82 @@ def test_watchdog_fires_on_stalled_consume():
     assert stats["watchdog_trips"] == 1
 
 
+def test_breaker_holds_open_across_watchdog_trip_no_flap():
+    """ISSUE-10 satellite: breaker/watchdog interaction under repeated
+    injected faults. A watchdog trip opens the breaker; the blackholed
+    step then RETURNS (slow, not dead) and successful steps resume
+    immediately — but the breaker must NOT flap closed off those early
+    successes (`record_success` from OPEN closes only once the cooldown
+    has held), and a later injected engine fault during the same window
+    must not re-count a trip. One trip, one recovery, monotone
+    closed -> open -> closed."""
+    engine = MockAsyncEngine(n_lanes=2, max_chunk=4, step_s=0.002)
+    # one consume blackholes for ~0.9s (watchdog deadline 0.2s) AND two
+    # dispatch faults land while the breaker is already open: repeated
+    # faults across the trip window
+    faults.arm(
+        "engine.consume:@4:n=1:kind=hang:hang=0.9;"
+        "engine.dispatch:@40:n=2"
+    )
+    breaker = CircuitBreaker(threshold=3, cooldown_s=0.6)
+    sched = _sched(engine, step_deadline_s=0.2, breaker=breaker)
+    reqs = _reqs(4, max_tokens=40)
+    sched.start()
+    flapped = []
+    stop_probe = threading.Event()
+
+    def probe():
+        # watch for an open->closed transition BEFORE the cooldown held
+        opened_at = None
+        while not stop_probe.is_set():
+            s = breaker.state
+            now = time.monotonic()
+            if s == "open" and opened_at is None:
+                opened_at = now
+            elif s == "closed" and opened_at is not None:
+                if now - opened_at < 0.5:  # cooldown is 0.6
+                    flapped.append(now - opened_at)
+                opened_at = None
+            time.sleep(0.005)
+
+    watcher = threading.Thread(target=probe, daemon=True)
+    watcher.start()
+    try:
+        for r in reqs:
+            try:
+                sched.submit(r)
+            except AdmissionRejected:
+                pass  # shed while open is correct behavior
+            time.sleep(0.05)
+        deadline = time.monotonic() + 30
+        while breaker.state != "open":
+            assert time.monotonic() < deadline, "watchdog never tripped"
+            time.sleep(0.01)
+        # recovery: successful steps + cooldown close it exactly once
+        deadline = time.monotonic() + 30
+        while breaker.state != "closed":
+            assert time.monotonic() < deadline, "breaker never recovered"
+            time.sleep(0.02)
+        for r in reqs:
+            if r.future.done() or r.submitted_at is not None:
+                try:
+                    r.future.result(timeout=60)
+                except Exception:  # noqa: BLE001 — faulted ones may error
+                    pass
+    finally:
+        stop_probe.set()
+        watcher.join(timeout=5)
+        sched.stop()
+    assert flapped == [], f"breaker flapped closed early: {flapped}"
+    br = breaker.stats()
+    # ONE trip (the watchdog's): the dispatch faults inside the open
+    # window are contained + counted but never re-trip an open breaker,
+    # and the early successes never closed it before the cooldown held
+    assert br["breaker_trips"] == 1, br
+    assert br["breaker_state"] == "closed"
+    assert sched.watchdog.stats()["watchdog_trips"] == 1
+
+
 def test_watchdog_unit_no_false_trip():
     """Armed steps that finish inside the deadline never trip; an armed
     step past the deadline trips exactly once."""

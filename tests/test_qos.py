@@ -529,7 +529,7 @@ def test_client_disconnect_cancels_and_scheduler_moves_on(stack):
     req1, _deltas = prepared
     caught = {}
 
-    def broken_pipe(_payload):
+    def broken_pipe(_payload, event_id=None):
         raise BrokenPipeError("client went away")
 
     def run():
