@@ -831,6 +831,174 @@ def _phase_serving_churn(config, small):
     }
 
 
+def _phase_serving_prefix(config, small):
+    """Paged KV + cross-request prefix sharing under a shared-system-
+    prompt Poisson workload with SESSIONS > LANES (the oversubscription
+    regime ROADMAP item 3 names): N sessions arrive Poisson against a
+    paged engine (``--paged-kv on`` equivalent), every prompt opens with
+    the same system prefix, and finished sessions PARK — their tree-
+    registered pages stay resident (refcounted) so follow-up admissions
+    share them copy-free. Reports the prefix hit rate, pages per
+    resident session, shared admissions and the zero-copy subset that
+    needed no single-page COW either (a paged engine refuses
+    ``copy_lane`` outright, so lane-copy HBM traffic is zero by
+    construction — ``serving_prefix_lane_copies`` counts actual
+    ``copy_lane`` entries to show it measured, not asserted), and the
+    park vs drop-rebuild TTFT pair: a parked follow-up served by
+    refcount bump against the same prompt re-prefilled from scratch
+    after ``drop_parked()`` (the LRU-eviction path an oversubscribed
+    admission takes; determinism of the rebuild is pinned in
+    tests/test_prefix_cache.py). ``pipeline_flushes`` must stay 0:
+    paged indirection lives inside the step families, not beside them.
+    CPU-smoke safe: small lane/session counts, deterministic arrivals."""
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.runtime.engine import warmup_engine
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+    from distributed_llama_multiusers_tpu.telemetry import Telemetry
+    from distributed_llama_multiusers_tpu.utils.testing import (
+        # prompt-DEPENDENT char-level encoding (shared text prefixes stay
+        # shared token prefixes), one home with tests/test_prefix_cache.py
+        CharStreamTokenizer,
+    )
+
+    n_lanes = 2 if small else 4
+    n_sessions = 3 * n_lanes  # oversubscription: sessions >> lanes
+    max_tokens = 8 if small else 32
+    system = "system: you are a terse assistant. answer briefly. "
+    params = _resident_packed_params(config)
+    engine = InferenceEngine(
+        config, params, n_lanes=n_lanes, prefill_buckets=(16,),
+        paged_kv=True, kv_page_size=16,
+    )
+    # MEASURE whole-lane HBM copy attempts instead of asserting zero:
+    # every copy_lane entry (the contiguous path's prefix-reuse
+    # primitive, the copy class this phase exists to show dying) is
+    # counted BEFORE the call — a paged engine refuses copy_lane, so a
+    # future change routing admissions back through a lane copy either
+    # surfaces in this count (if the refusal were lifted) or fails the
+    # phase loudly on the refusal; it can never read as a silent 0
+    lane_copy_calls = 0
+    _orig_copy_lane = engine.copy_lane
+
+    def _counting_copy_lane(src, dst, prefix_len=None):
+        nonlocal lane_copy_calls
+        lane_copy_calls += 1
+        return _orig_copy_lane(src, dst, prefix_len=prefix_len)
+
+    engine.copy_lane = _counting_copy_lane
+    tokenizer = CharStreamTokenizer(config.vocab_size, max_chars=96)
+    telemetry = Telemetry()
+    sched = ContinuousBatchingScheduler(engine, tokenizer,
+                                        telemetry=telemetry)
+    warmup_engine(engine, spec=True, multi_step=sched.multi_step)
+
+    rng = np.random.default_rng(11)
+    intervals = rng.exponential(0.05, n_sessions)
+    reqs = [
+        Request(prompt=system + f"user {i}: question {i}",
+                max_tokens=max_tokens,
+                temperature=0.0 if i % 2 == 0 else 0.8, seed=300 + i)
+        for i in range(n_sessions)
+    ]
+    sched.start()
+    t0 = time.perf_counter()
+    try:
+        for r, dt in zip(reqs, intervals):
+            time.sleep(dt)
+            sched.submit(r)
+        for r in reqs:
+            r.future.result(timeout=600)
+        wall = time.perf_counter() - t0
+        assert all(r.error is None for r in reqs), [r.error for r in reqs]
+        toks = sum(len(r.generated_tokens) for r in reqs)
+        pool_wave = engine.pool_stats()
+
+        def ttft_one():
+            r = Request(prompt=system + "user 0: question 0",
+                        max_tokens=2, temperature=0.0)
+            t = time.perf_counter()
+            sched.submit(r)
+            r.future.result(timeout=600)
+            assert r.error is None, r.error
+            return (time.perf_counter() - t) * 1e3
+
+        # warm: the follow-up's prefix is served from PARKED pages by
+        # refcount bump (plus at most one single-page COW)
+        park_ttft_ms = ttft_one()
+        # pressure: drop every parked session (what LRU eviction does
+        # under an oversubscribed admission), then rebuild from scratch
+        dropped = engine.kvpool.drop_parked()
+        rebuild_ttft_ms = ttft_one()
+    finally:
+        sched.stop()
+    stats = engine.stats.snapshot()
+    pool = engine.pool_stats()
+
+    return {
+        "serving_prefix_tok_s": round(toks / wall, 2),
+        "serving_prefix_lanes": n_lanes,
+        "serving_prefix_sessions": n_sessions,
+        # resident sessions at the end of the wave: every finished
+        # session parked (>= 2x lanes = the oversubscription headline)
+        "serving_prefix_resident_sessions": pool_wave[
+            "pool_parked_sessions"
+        ],
+        "serving_prefix_hit_rate": round(
+            pool_wave["pool_prefix_admits"]
+            / max(1, pool_wave["pool_admits"]), 3
+        ),
+        "serving_prefix_tokens_shared": pool_wave[
+            "pool_prefix_tokens_shared"
+        ],
+        # shared-prefix admissions: full blocks by refcount bump on the
+        # SAME physical pages, plus AT MOST one single-page COW at a
+        # divergent block (the pool counts one cow_copy per such
+        # admission, so shared - cow = the subset that needed no page
+        # traffic at all). Both from the SAME end-of-wave snapshot, so
+        # the subset can never read larger than its superset. Whole-lane
+        # (copy_lane-class) copies are the class this layout kills —
+        # measured via the call counter
+        "serving_prefix_shared_admissions": pool_wave[
+            "pool_prefix_admits"
+        ],
+        "serving_prefix_zero_copy_admissions": (
+            pool_wave["pool_prefix_admits"] - pool_wave["pool_cow_copies"]
+        ),
+        "serving_prefix_lane_copies": lane_copy_calls,
+        "serving_prefix_cow_copies": pool_wave["pool_cow_copies"],
+        # HBM cost of a resident (parked) session, in pages: parked
+        # pages are DISTINCT physical pages (shared pages count once),
+        # so LOWER = sessions overlap more — pure-private sessions
+        # would each pay their full ceil((prompt+gen)/page)
+        "serving_prefix_pages_per_session": round(
+            pool_wave["pool_parked_pages"]
+            / max(1, pool_wave["pool_parked_sessions"]), 2
+        ),
+        "serving_prefix_pool_pages_total": pool["pool_pages_total"],
+        "serving_prefix_park_ttft_ms": round(park_ttft_ms, 2),
+        "serving_prefix_dropped_sessions": dropped,
+        "serving_prefix_rebuild_ttft_ms": round(rebuild_ttft_ms, 2),
+        "serving_prefix_parked_evicted": pool["pool_parked_evicted"],
+        "serving_prefix_exhausted_sheds": pool["pool_exhausted_sheds"],
+        "serving_prefix_ttft_ms_p50": (
+            None if telemetry.ttft.quantile(0.5) is None
+            else round(telemetry.ttft.quantile(0.5) * 1e3, 2)
+        ),
+        "serving_prefix_ttft_ms_p95": (
+            None if telemetry.ttft.quantile(0.95) is None
+            else round(telemetry.ttft.quantile(0.95) * 1e3, 2)
+        ),
+        "serving_prefix_pipeline_flushes": stats["pipeline_flushes"],
+        "serving_prefix_prefix_hits": stats["prefix_hits"],
+        "serving_prefix_prefix_tokens_saved": stats["prefix_tokens_saved"],
+    }
+
+
 def _phase_pod_serving(config, small):
     """Pod-native serving: the churn workload (the `serving_churn` phase's
     exact arrival process) on a pure-TP mesh(tp=N) with the Q40 planes
@@ -1560,6 +1728,8 @@ def child_main() -> None:
         result = _phase_serving(config, small)
     elif phase == "serving_churn":
         result = _phase_serving_churn(config, small)
+    elif phase == "serving_prefix":
+        result = _phase_serving_prefix(config, small)
     elif phase == "pod_serving":
         result = _phase_pod_serving(config, small)
     elif phase == "serving_faults":
@@ -1722,7 +1892,8 @@ def main() -> None:
     # decodes), and a timeout kill mid-TPU-RPC has wedged the tunnel for
     # every phase after it (round 5) — order so a wedge costs nothing.
     for phase, cap in (
-        ("serving", 420.0), ("serving_churn", 300.0), ("pod_serving", 300.0),
+        ("serving", 420.0), ("serving_churn", 300.0),
+        ("serving_prefix", 240.0), ("pod_serving", 300.0),
         ("serving_faults", 240.0), ("serving_recovery", 240.0),
         ("8b", 500.0), ("ablations", 420.0), ("longctx", 300.0),
     ):

@@ -45,6 +45,10 @@ from .core import (
 
 SCOPE = (
     "runtime/engine.py", "runtime/scheduler.py", "runtime/spec.py",
+    # the paged KV pool's bookkeeping runs inside the admission path
+    # (runtime/scheduler._start_request -> engine.paged_admit); host
+    # dicts/lists by contract, never a device value
+    "runtime/kvpool.py",
     # the telemetry package rides the serving loop (scheduler hooks);
     # registered file-by-file because scope matching is suffix-based
     "telemetry/__init__.py", "telemetry/hub.py", "telemetry/spans.py",

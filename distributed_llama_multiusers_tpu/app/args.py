@@ -84,6 +84,41 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "request shares at least this many leading prompt "
                         "tokens (prefix caching); 0 disables; default: "
                         "scheduler default (16)")
+    # paged KV pool (runtime/kvpool.py; docs/SERVING.md "Paged KV")
+    p.add_argument("--paged-kv", default="off", choices=["on", "off"],
+                   help="serving: store KV as a pooled set of fixed-size "
+                        "pages behind a per-lane page table instead of "
+                        "contiguous per-lane planes. Prefix sharing "
+                        "becomes a refcount bump on the SAME physical "
+                        "pages (zero HBM copies; copy_lane dies), "
+                        "divergence is a single-page copy-on-write, and "
+                        "finished sessions park their sharable pages so "
+                        "resident sessions exceed lanes; pool exhaustion "
+                        "sheds with a retryable 429. Token streams are "
+                        "byte-identical to the contiguous layout. 'off' "
+                        "(default) keeps contiguous planes bit-for-bit "
+                        "(escape hatch)")
+    p.add_argument("--kv-page-size", type=int, default=None,
+                   help="--paged-kv on: tokens per KV page (power of two; "
+                        "shrunk automatically to fit short contexts). "
+                        "Smaller pages = finer sharing granularity and "
+                        "less tail waste, larger = smaller page tables "
+                        "and fewer, bigger COW copies; default: pool "
+                        "default (64)")
+    p.add_argument("--kv-pool-pages", type=int, default=None,
+                   help="--paged-kv on: total pages in the device pool "
+                        "(default: the contiguous layout's exact HBM "
+                        "footprint, max-lanes x blocks-per-full-lane — "
+                        "oversubscription then comes from sessions "
+                        "reserving only prompt + max_tokens, not from a "
+                        "bigger pool)")
+    p.add_argument("--kv-max-parked", type=int, default=None,
+                   help="--paged-kv on: max finished sessions whose "
+                        "sharable prefix pages stay resident (refcounted, "
+                        "LRU-evicted under pool pressure; evicted "
+                        "sessions rebuild deterministically from the "
+                        "request journal on next activity); 0 disables "
+                        "parking; default: pool default (64)")
     # serving QoS (serving/ package): bounded admission + deadlines
     p.add_argument("--max-queue", type=int, default=256,
                    help="serving: max requests waiting for a lane before "

@@ -16,6 +16,7 @@ from ..models.loader import load_params_from_m_quantized
 from ..parallel import make_mesh, validate_mesh_for_config
 from ..parallel.sharding import shard_params
 from ..runtime import ContinuousBatchingScheduler, InferenceEngine
+from ..runtime.kvpool import DEFAULT_MAX_PARKED, DEFAULT_PAGE_SIZE
 from ..tokenizer import Tokenizer
 from .args import parse_mesh_spec
 
@@ -201,11 +202,40 @@ def load_stack(args, n_lanes: int | None = None):
         # async decode pipeline ring bound (None -> engine default 2);
         # every process must agree, like --max-lanes
         pipeline_depth=getattr(args, "pipeline_depth", None),
+        # paged KV pool (runtime/kvpool.py): every process must agree on
+        # the layout — the table leaf is part of the compiled programs'
+        # pytree structure (OP_KV_TABLE replays assume paged workers)
+        paged_kv=getattr(args, "paged_kv", "off") == "on",
+        # pass explicit values through unmodified (None = flag absent):
+        # a 0/negative --kv-page-size must die in for_seq_len's
+        # validation, not silently become the default
+        kv_page_size=(DEFAULT_PAGE_SIZE
+                      if getattr(args, "kv_page_size", None) is None
+                      else args.kv_page_size),
+        kv_pool_pages=getattr(args, "kv_pool_pages", None),
+        kv_max_parked=(DEFAULT_MAX_PARKED
+                       if getattr(args, "kv_max_parked", None) is None
+                       else args.kv_max_parked),
     )
+    if engine.kvpool is not None:
+        log(
+            "📑",
+            f"Paged KV: {engine.kvpool.n_pages} pages x "
+            f"{engine.kvpool.page_size} tokens, "
+            f"{engine.kvpool.blocks_per_lane} blocks/lane, "
+            f"max parked {engine.kvpool.max_parked} "
+            "(--paged-kv off restores contiguous planes)",
+        )
     if n_proc > 1:
         from ..parallel.multihost import ControlPlane, RootControlEngine
 
-        plane = ControlPlane(engine.n_lanes, chunk=engine.prefill_buckets[-1])
+        # packet slots must fit the largest prefill chunk AND (paged) a
+        # full page-table row, or send_kv_table's pre-broadcast check
+        # rejects long-context table updates
+        plane_chunk = engine.prefill_buckets[-1]
+        if engine.kvpool is not None:
+            plane_chunk = max(plane_chunk, engine.kvpool.blocks_per_lane)
+        plane = ControlPlane(engine.n_lanes, chunk=plane_chunk)
         if jax.process_index() == 0:
             log("⭕", f"Multi-host root: {n_proc} processes, control plane up")
             engine = RootControlEngine(engine, plane)
@@ -257,17 +287,31 @@ def make_scheduler(engine, tokenizer, args=None) -> ContinuousBatchingScheduler:
     # bounded admission with per-user fair share, plus deadlines
     max_queue = getattr(args, "max_queue", 0) or 0
     policy = DeadlinePolicy.from_args(args) if args is not None else DeadlinePolicy()
+    # paged engines charge DRR fair share in PAGES — what admission
+    # actually takes from the pool — instead of decode tokens; the
+    # quantum rescales so the rotation grain stays ~128 tokens' worth
+    qos_kw = {}
+    pool = getattr(engine, "kvpool", None)
+    if pool is not None:
+        from ..serving.qos import page_cost
+
+        qos_kw = {
+            "cost": page_cost(pool.page_size),
+            "quantum": max(1.0, 128.0 / pool.page_size),
+        }
     log(
         "🚦",
         f"QoS: queue capacity {max_queue or 'unbounded'}, "
         f"queue timeout {policy.queue_timeout_s or 'off'}, "
-        f"request budget {policy.request_budget_s or 'off'}",
+        f"request budget {policy.request_budget_s or 'off'}"
+        + (", fair share in KV pages" if pool is not None else ""),
     )
     log("⏳", "Warming serving programs (prefill buckets, decode, spec)...")
     t0 = time.perf_counter()
     sched = ContinuousBatchingScheduler(
         engine, tokenizer, speculative=speculative,
-        queue_=QosQueue(capacity=max_queue), deadlines=policy, **overrides,
+        queue_=QosQueue(capacity=max_queue, **qos_kw),
+        deadlines=policy, **overrides,
     )
     warmup_engine(engine, spec=speculative, multi_step=sched.multi_step)
     log("⏳", f"Warmup done in {time.perf_counter() - t0:.1f}s")

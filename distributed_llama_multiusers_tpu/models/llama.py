@@ -80,9 +80,53 @@ class KVCache(NamedTuple):
     v: jnp.ndarray  # [L, B, S, n_kv_heads, head_size]
 
 
+class PagedKVCache(NamedTuple):
+    """Paged KV layout (the vLLM-style indirection): one device-resident
+    pool of fixed-size pages shared by every lane, plus a per-lane page
+    table mapping logical block ``b`` of lane ``i`` to physical page
+    ``table[i, b]``. A page holds ``page_size`` tokens' K/V for EVERY
+    layer at the same physical index, so one table drives all layers.
+
+    ``table`` entries equal to ``n_pages`` mean "unmapped": writes
+    through them are dropped by the ``mode="drop"`` scatter and reads
+    land past the attention mask. The table rides the cache pytree, so
+    every compiled step family threads the indirection automatically —
+    no signature changes, and a table update between dispatches is just
+    a new pytree leaf (the pool arrays are donated through as always)."""
+
+    k: jnp.ndarray  # [L, n_pages, page_size, n_kv_heads, head_size]
+    v: jnp.ndarray  # [L, n_pages, page_size, n_kv_heads, head_size]
+    table: jnp.ndarray  # [B, blocks_per_lane] int32 physical page ids
+
+
 def init_kv_cache(config: LlamaConfig, n_lanes: int, dtype=jnp.float32) -> KVCache:
     shape = (config.n_layers, n_lanes, config.seq_len, config.n_kv_heads, config.head_size)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def init_paged_kv_cache(
+    config: LlamaConfig,
+    n_lanes: int,
+    n_pages: int,
+    page_size: int,
+    n_blocks: int | None = None,
+    dtype=jnp.float32,
+) -> PagedKVCache:
+    """Zero-filled page pool + all-unmapped tables (every entry is the
+    ``n_pages`` sentinel; admission maps real pages per lane).
+    ``n_blocks`` is the table width — pass the pool's authoritative
+    ``blocks_per_lane`` so the device leaf and the host mirror cannot
+    drift; the ceil-div fallback serves direct/test construction."""
+    blocks = n_blocks if n_blocks is not None else -(-config.seq_len // page_size)
+    shape = (
+        config.n_layers, n_pages, page_size,
+        config.n_kv_heads, config.head_size,
+    )
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        table=jnp.full((n_lanes, blocks), n_pages, jnp.int32),
+    )
 
 
 def _to_cache_dtype(x: jnp.ndarray, dtype) -> jnp.ndarray:
@@ -315,6 +359,14 @@ def llama_forward(
     Works for prefill (T > 1) and decode (T = 1) alike; the KV cache is
     per-lane (fixes reference defect (c) where all lanes shared one cache).
 
+    ``cache`` may be a :class:`PagedKVCache` (paged attention): K/V are
+    gathered per lane through the page table into the same ``[B, S, ...]``
+    view the contiguous path reads — identical values in identical order,
+    so the attention math (and the token streams) are byte-identical to
+    the contiguous layout — and the KV append scatters through the table
+    to ``(page, slot)``. The choice is a pytree-structure property, fixed
+    at trace time: one compiled program per layout, no runtime flag.
+
     With ``mesh`` (axes dp/tp/sp) and sp > 1, attention runs sequence-
     parallel over the S-sharded cache via flash-stats psum
     (parallel/ring_attention.sp_attention) instead of relying on GSPMD to
@@ -333,7 +385,12 @@ def llama_forward(
     act_fn = silu if h_cfg.hidden_act == HiddenAct.SILU else gelu
 
     maybe_qdq = _qdq_q80 if emulate_q80_activations else (lambda y: y)
-    use_sp = _use_sp(mesh, b)
+    paged = isinstance(cache, PagedKVCache)
+    # sp (sequence-parallel) attention shards the contiguous S axis; the
+    # paged pool has no per-lane S axis to shard, so paged caches take
+    # the dense path (GSPMD still partitions the einsums) — pod serving
+    # meshes are pure-TP, where the pool shards over kv heads instead
+    use_sp = _use_sp(mesh, b) and not paged
     use_q80_sync = False
     if q80_sync and mesh is not None:
         from ..parallel.collectives import q80_sync_engages, q80_sync_matmul
@@ -376,8 +433,29 @@ def llama_forward(
     s_idx = jnp.arange(h_cfg.seq_len)  # [S]
     attn_mask = s_idx[None, None, :] <= positions[:, :, None]  # [B, T, S]
 
+    if paged:
+        # page indirection, computed ONCE (the table is layer-invariant):
+        # write targets (page, slot) per (lane, position) and the flat
+        # gather index reassembling each lane's logical [S] view from its
+        # pages. Sentinel table entries (== n_pages: unmapped blocks) and
+        # positions >= seq_len (parked/idle lanes) become out-of-range
+        # indices — the mode="drop" scatter discards those writes and the
+        # clamped gather reads slots the s <= pos mask already excludes.
+        n_pages, page = cache.k.shape[1], cache.k.shape[2]
+        table = cache.table  # [B, blocks_per_lane]
+        n_blocks = table.shape[1]
+        w_blk = jnp.clip(positions // page, 0, n_blocks - 1)
+        w_page = jnp.take_along_axis(table, w_blk, axis=1)  # [B, T]
+        w_page = jnp.where(positions < h_cfg.seq_len, w_page, n_pages)
+        w_slot = positions % page
+        gather_idx = (
+            table[:, :, None] * page
+            + jnp.arange(page, dtype=jnp.int32)[None, None, :]
+        ).reshape(b, n_blocks * page)[:, : h_cfg.seq_len]  # [B, S]
+
     def layer_step(x, layer_in):
-        lp, k_cache, v_cache = layer_in  # k/v: [B, S, n_kv, hd]
+        lp, k_cache, v_cache = layer_in  # contiguous: [B, S, n_kv, hd];
+        # paged: [n_pages, page_size, n_kv, hd]
         dtype = x.dtype
 
         y = rms_norm(x, lp.rms_att, eps)
@@ -394,18 +472,40 @@ def llama_forward(
         # semantics: a speculative-verify lane near seq_len writes its
         # overshooting draft slots nowhere, so per-lane spec gating needs no
         # global barrier (scheduler._run's per-lane d_max relies on this).
-        k_cache = k_cache.at[lane_idx, positions].set(
-            _to_cache_dtype(k, k_cache.dtype), mode="drop"
-        )
-        v_cache = v_cache.at[lane_idx, positions].set(
-            _to_cache_dtype(v, v_cache.dtype), mode="drop"
-        )
+        # Paged caches scatter through the page table to (page, slot)
+        # instead of (lane, position) — same drop rule, and unmapped
+        # sentinel entries drop the write too.
+        if paged:
+            k_cache = k_cache.at[w_page, w_slot].set(
+                _to_cache_dtype(k, k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[w_page, w_slot].set(
+                _to_cache_dtype(v, v_cache.dtype), mode="drop"
+            )
+        else:
+            k_cache = k_cache.at[lane_idx, positions].set(
+                _to_cache_dtype(k, k_cache.dtype), mode="drop"
+            )
+            v_cache = v_cache.at[lane_idx, positions].set(
+                _to_cache_dtype(v, v_cache.dtype), mode="drop"
+            )
 
         # GQA attention in f32 (reference multiheadAtt_F32, nn-cpu-ops.cpp:749-784)
         group = n_heads // n_kv
         qf = q.astype(jnp.float32).reshape(b, t, n_kv, group, hd)
         scale = 1.0 / float(hd) ** 0.5
-        if use_sp:
+        if paged:
+            # gather each lane's logical [S] view through the page table:
+            # the same values a contiguous lane plane would hold, in the
+            # same order, so the f32 attention below is byte-identical to
+            # the contiguous path (pinned by tests/test_prefix_cache.py)
+            kf = k_cache.reshape(n_pages * page, n_kv, hd)[gather_idx]
+            vf = v_cache.reshape(n_pages * page, n_kv, hd)[gather_idx]
+            attn = _dense_attention(
+                qf, kf.astype(jnp.float32), vf.astype(jnp.float32),
+                attn_mask, scale,
+            )
+        elif use_sp:
             from ..parallel.ring_attention import sp_attention
 
             attn = sp_attention(qf, k_cache, v_cache, positions, mesh, scale)
@@ -443,7 +543,12 @@ def llama_forward(
     logits = matmul(maybe_qdq(y), params.wcls).astype(jnp.float32)  # [B, T, vocab]
     # wcls may be padded past vocab_size for the slab kernel's wide tiles
     # (quants/packed.pad_packed_d_out); identity slice otherwise
-    return logits[..., : h_cfg.vocab_size], KVCache(k=new_k, v=new_v)
+    out_cache = (
+        PagedKVCache(k=new_k, v=new_v, table=cache.table)
+        if paged
+        else KVCache(k=new_k, v=new_v)
+    )
+    return logits[..., : h_cfg.vocab_size], out_cache
 
 
 def llama_forward_train(

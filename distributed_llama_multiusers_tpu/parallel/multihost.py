@@ -54,7 +54,13 @@ PACKET_MAGIC = 0x444C4C41  # "DLLA"
 # SIZE changed, so a v1 peer cannot even frame a v2 broadcast — the
 # version word turns that into a classified ReplayError instead of a
 # garbage replay.
-PROTOCOL_VERSION = 2
+# v3: paged KV — OP_KV_TABLE ships page-table rows + COW page copies. The
+# packet size did NOT change, so a v2 peer COULD frame a v3 broadcast and
+# would replay every op except the table updates — leaving its replicated
+# page tables silently stale (wrong gathers, not a deadlock). The bump
+# turns that silent divergence into a classified ReplayError on the first
+# packet.
+PROTOCOL_VERSION = 3
 
 OP_STOP = 0
 OP_PREFILL = 1
@@ -82,6 +88,13 @@ OP_DECODE_SPEC_PREFILL_FUSED = 11  # the full composition: an admitting
 # prompt chunk AND a spec verify step share one dispatch — the
 # SPEC_PIPELINED slots plus the chunk (slot 7) and the prefill header
 # (slot 8, the DECODE_PREFILL_FUSED layout)
+OP_KV_TABLE = 12  # paged KV (runtime/kvpool.py): one lane's page-table row
+# (slot 0, n entries) + flattened COW page copies (slot 1, start_pos
+# pairs) — the pool bookkeeping (free list, refcounts, prefix tree) is
+# root-only HOST state, so only its device half replays: workers apply
+# the copies and the new table row via engine.apply_paged_admit, keeping
+# the replicated table leaf byte-identical on every process. lane == -1
+# means "unmap every lane" (containment reset, engine.paged_unmap_all).
 
 
 class ReplayError(RuntimeError):
@@ -360,6 +373,23 @@ class ControlPlane:
     def send_copy_lane(self, src: int, dst: int) -> None:
         # header fields carry the operands: lane=src, start_pos=dst
         self._send(OP_COPY_LANE, src, 0, dst)
+
+    def send_kv_table(self, lane: int, row, copies) -> None:
+        """Paged-KV table update: row length rides ``n``, the COW pair
+        count rides ``start_pos``; lane == -1 unmaps every lane (reset).
+        Raises (pre-broadcast, the pod-deadlock rule) when the row or the
+        copies outgrow their packet slots."""
+        row = np.asarray(row, np.int32)
+        flat = np.asarray(
+            [c for pair in copies for c in pair], np.int32
+        )
+        if len(row) > self.chunk or len(flat) > self.chunk:
+            raise ValueError(
+                f"kv table payload (row {len(row)}, copies {len(flat)}) "
+                f"exceeds packet slot {self.chunk}; size "
+                "ControlPlane(chunk=...) >= the engine's blocks-per-lane"
+            )
+        self._send(OP_KV_TABLE, lane, len(row), len(copies), row, flat)
 
     def recv(self) -> np.ndarray:
         faults.fire("plane.recv")  # chaos harness; no-op unarmed
@@ -678,15 +708,62 @@ class RootControlEngine:
         (the root restores its own via ``stats.preserved()``)."""
         self._plane.send_stats_reset()
 
-    def copy_lane(self, src: int, dst: int) -> None:
+    def copy_lane(self, src: int, dst: int,
+                  prefix_len: int | None = None) -> None:
         """Prefix caching on a pod: every process must dispatch the same
         cache-copy program (the cache is sharded over the global mesh), so
         the operands ride a control packet before the root-side call —
         __getattr__ forwarding alone would desync the workers."""
-        if src == dst:
-            return
+        if src == dst or prefix_len == 0:
+            return  # the engine-side short-circuit, BEFORE any packet
         self._plane.send_copy_lane(src, dst)
         self._engine.copy_lane(src, dst)
+
+    def apply_paged_admit(self, lane: int, row, copies) -> None:
+        """Device half of a paged table update on a pod: broadcast the
+        row + COW copies (OP_KV_TABLE) so every process dispatches the
+        same page-copy program and lands the same table leaf —
+        __getattr__ forwarding alone would desync the workers (the pool
+        arrays are sharded over the global mesh). warmup_engine drives
+        this directly to pre-compile the COW program."""
+        self._plane.send_kv_table(lane, row, copies)
+        self._engine.apply_paged_admit(lane, row, copies)
+
+    def paged_admit(self, lane: int, tokens, reserve_tokens: int,
+                    min_share_tokens: int = 1) -> int:
+        """Paged admission on a pod: the pool bookkeeping (free list,
+        refcounts, prefix tree) is HOST state and runs root-only, BEFORE
+        the broadcast — so :class:`~..runtime.kvpool.PoolExhausted` (the
+        admission shed) raises with no packet on the wire. Only the
+        device half replays: the COW page copies and the new table row
+        ride OP_KV_TABLE so every process's replicated table leaf (and
+        the compiled gathers through it) stay byte-identical."""
+        start, blocks, copies = self._engine.kvpool.admit(
+            lane, list(tokens), reserve_tokens, min_share_tokens
+        )
+        self.apply_paged_admit(
+            lane, self._engine._paged_table_row(blocks), copies
+        )
+        return start
+
+    def paged_finish(self, lane: int, park: bool = True) -> None:
+        """Paged release on a pod: host bookkeeping (park/free) root-only
+        and pre-broadcast, then the all-unmapped table row replays on
+        every process — no packet at all when the lane never mapped
+        anything (the exhaustion-shed reject path), matching the
+        single-process skip so workers stay in step."""
+        if self._engine.kvpool.finish(lane, park=park):
+            self.apply_paged_admit(
+                lane, self._engine._paged_table_row([]), []
+            )
+
+    def paged_reset(self) -> None:
+        """Paged containment on a pod: drop the root's pool bookkeeping
+        (host-only), then have every process unmap every lane — lane -1
+        is the reset form of OP_KV_TABLE."""
+        self._engine.kvpool.reset()
+        self._plane.send_kv_table(-1, [], [])
+        self._engine.paged_unmap_all()
 
 
 def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
@@ -837,6 +914,41 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
             engine.stats.reset()
         elif op == OP_COPY_LANE:
             engine.copy_lane(lane, start_pos)  # src, dst ride the header
+        elif op == OP_KV_TABLE:
+            # paged KV table update: row length rides n, COW pair count
+            # rides start_pos, lane -1 = unmap everything (containment).
+            # A non-paged engine receiving this is a config skew (root
+            # and worker disagree on --paged-kv) — classified
+            # pre-dispatch, no collective was entered on it
+            if getattr(engine, "kvpool", None) is None:
+                raise ReplayError(
+                    "OP_KV_TABLE on a non-paged engine: root and worker "
+                    "--paged-kv flags are skewed"
+                )
+            if lane < 0:
+                engine.paged_unmap_all()
+            else:
+                if n != engine.kvpool.blocks_per_lane:
+                    # geometry skew (root and worker disagree on
+                    # --kv-page-size/--kv-pool-pages): classified
+                    # pre-apply like the paged/non-paged skew above,
+                    # instead of an unclassified broadcast-shape crash
+                    # that burns a worker restart per admission
+                    raise ReplayError(
+                        f"OP_KV_TABLE row of {n} entries vs this "
+                        f"worker's {engine.kvpool.blocks_per_lane} "
+                        "blocks/lane: root and worker paged-KV "
+                        "geometry flags are skewed"
+                    )
+                pairs = plane.slot(pkt, 1, 2 * start_pos)
+                engine.apply_paged_admit(
+                    lane,
+                    plane.slot(pkt, 0, n).copy(),
+                    list(zip(
+                        (int(s) for s in pairs[0::2]),
+                        (int(d) for d in pairs[1::2]),
+                    )),
+                )
         else:
             # classified, pre-dispatch (no engine call was made for this
             # packet): worker_serve resubscribes without burning a restart

@@ -22,7 +22,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.llama import KVCache, LlamaLayerParams, LlamaParams
+from ..models.llama import KVCache, LlamaLayerParams, LlamaParams, PagedKVCache
 from ..quants.packed import PackedQ40
 
 
@@ -107,6 +107,22 @@ def cache_shardings(mesh: Mesh) -> KVCache:
     return KVCache(
         k=ns(None, "dp", "sp", "tp", None),
         v=ns(None, "dp", "sp", "tp", None),
+    )
+
+
+def paged_cache_shardings(mesh: Mesh) -> PagedKVCache:
+    """Paged KV pool [L, n_pages, page_size, n_kv, hd] + table [B, blocks]:
+    kv heads over tp like the contiguous cache; the page axis is NOT
+    sharded — the pool is one global resource every lane maps into, so
+    splitting it over dp would re-partition pages by device and break the
+    any-lane-any-page indirection (serving pod meshes are pure-TP; see
+    llama_forward's paged sp note). The table is a few KB of int32 and
+    rides replicated so every shard gathers identically."""
+    ns = lambda *spec: NamedSharding(mesh, P(*spec))
+    return PagedKVCache(
+        k=ns(None, None, None, "tp", None),
+        v=ns(None, None, None, "tp", None),
+        table=ns(None, None),
     )
 
 

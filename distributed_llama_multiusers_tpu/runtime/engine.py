@@ -32,9 +32,17 @@ import jax.numpy as jnp
 
 from ..lockcheck import make_lock
 from ..models.config import LlamaConfig
-from ..models.llama import KVCache, LlamaParams, init_kv_cache, llama_forward
+from ..models.llama import (
+    KVCache,
+    LlamaParams,
+    PagedKVCache,
+    init_kv_cache,
+    init_paged_kv_cache,
+    llama_forward,
+)
 from ..telemetry.logs import log_event
 from ..utils import faults
+from .kvpool import DEFAULT_MAX_PARKED, DEFAULT_PAGE_SIZE, KVPagePool
 from .spec import SPEC_DRAFT
 
 DEFAULT_PREFILL_BUCKETS = (16, 64, 256, 1024)
@@ -216,7 +224,25 @@ class InferenceEngine:
         device_topk: int = 64,
         q80_sync: bool = False,
         pipeline_depth: int | None = None,
+        paged_kv: bool = False,
+        kv_page_size: int = DEFAULT_PAGE_SIZE,
+        kv_pool_pages: int | None = None,
+        kv_max_parked: int = DEFAULT_MAX_PARKED,
     ):
+        """``paged_kv=True`` stores KV as a pooled set of fixed-size pages
+        behind a per-lane page table (runtime/kvpool.py) instead of
+        contiguous per-lane planes: prefix sharing becomes a refcount
+        bump on the shared pages (zero HBM copies; ``copy_lane`` is the
+        contiguous path's primitive and is refused here), divergence is
+        served by a single-page copy-on-write, and finished sessions
+        park their sharable pages so resident sessions exceed lanes.
+        Token streams are byte-identical to the contiguous layout
+        (pinned). ``kv_page_size`` is the page granularity in tokens
+        (power of two; shrunk to fit short seq_len configs);
+        ``kv_pool_pages`` sizes the pool (default: the contiguous
+        layout's exact footprint, n_lanes x blocks-per-full-lane);
+        ``kv_max_parked`` bounds parked sessions (LRU-evicted under pool
+        pressure)."""
         self.config = config
         self.params = params
         self.n_lanes = n_lanes
@@ -232,7 +258,62 @@ class InferenceEngine:
                 jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
             )
         self.cache_dtype = cache_dtype
-        if mesh is not None:
+        if paged_kv:
+            if mesh is not None and (
+                dict(mesh.shape).get("dp", 1) > 1
+                or dict(mesh.shape).get("sp", 1) > 1
+            ):
+                # the pool is ONE global resource every lane maps into
+                # (parallel/sharding.paged_cache_shardings): under dp it
+                # would replicate — sized to the contiguous layout's
+                # WHOLE footprint, a dp-fold HBM regression — and sp has
+                # no per-lane S axis to shard. Serving pod meshes are
+                # pure-TP; refuse the silent misconfiguration.
+                raise ValueError(
+                    "paged_kv requires a pure-TP mesh (dp=1, sp=1): the "
+                    "page pool replicates over dp and cannot shard over "
+                    "sp — use --paged-kv off on dp/sp meshes"
+                )
+            # paged pool: page granularity shrinks to fit short contexts
+            # (tiny test configs) but stays a power of two; the default
+            # pool size is the contiguous layout's exact HBM footprint —
+            # oversubscription comes from sessions reserving only what
+            # they can use (prompt + max_tokens), not a bigger pool
+            # one construction recipe (validation, power-of-two shrink,
+            # contiguous-footprint default), shared with the mock so the
+            # scheduler-level tests exercise the identical pool geometry
+            self.kvpool = KVPagePool.for_seq_len(
+                config.seq_len, n_lanes, page_size=kv_page_size,
+                pool_pages=kv_pool_pages, max_parked=kv_max_parked,
+            )
+            bs = self.kvpool.page_size
+            n_pages = self.kvpool.n_pages
+            # dlint: ok[host-sync] host int lists -> the numpy table mirror; no device value involved
+            self._host_tables = np.asarray(
+                [self.kvpool.table_row([])] * n_lanes, np.int32
+            )
+            init_fn = partial(
+                init_paged_kv_cache, config, n_lanes, n_pages, bs,
+                n_blocks=self.kvpool.blocks_per_lane, dtype=cache_dtype,
+            )
+            if mesh is not None:
+                from ..parallel.sharding import paged_cache_shardings
+
+                shardings = paged_cache_shardings(mesh)
+                self.cache = jax.jit(
+                    init_fn, out_shardings=shardings
+                )()
+                # every table replacement must carry this sharding: a
+                # bare jnp.asarray leaf would change the compiled
+                # programs' input aval (recompile per admission on a
+                # single-host mesh; incompatible-devices failure on a
+                # multi-process pod)
+                self._table_sharding = shardings.table
+            else:
+                self.cache = init_fn()
+                self._table_sharding = None
+        elif mesh is not None:
+            self.kvpool = None
             # materialize the cache already placed (lanes over dp, sequence
             # over sp, kv heads over tp — parallel/sharding.cache_shardings);
             # round 2 left serving caches unplaced, so GSPMD chose for us
@@ -243,6 +324,7 @@ class InferenceEngine:
                 out_shardings=cache_shardings(mesh),
             )()
         else:
+            self.kvpool = None
             self.cache = init_kv_cache(config, n_lanes, dtype=cache_dtype)
         self.stats = EngineStats()
         self.device_topk = min(device_topk, config.vocab_size)
@@ -608,22 +690,43 @@ class InferenceEngine:
             identical program (a root-only jit over the global-mesh logits
             would not be dispatchable)."""
             bucket = tokens.shape[0]
-            # slice this lane's cache to batch-of-1
-            k_lane = jax.lax.dynamic_slice_in_dim(cache.k, lane, 1, axis=1)
-            v_lane = jax.lax.dynamic_slice_in_dim(cache.v, lane, 1, axis=1)
             positions = start_pos + jnp.arange(bucket, dtype=jnp.int32)
-            logits, lane_cache = llama_forward(
-                cfg,
-                params,
-                tokens[None, :],
-                positions[None, :],
-                KVCache(k=k_lane, v=v_lane),
-                emulate_q80_activations=q80,
-                mesh=sp_mesh,
-                q80_sync=q80s,
-            )
-            k = jax.lax.dynamic_update_slice_in_dim(cache.k, lane_cache.k, lane, axis=1)
-            v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)
+            if isinstance(cache, PagedKVCache):
+                # paged layout: there is no per-lane plane to slice — the
+                # POOL rides whole and the lane's one-ROW page table scopes
+                # every write and read to that lane's pages (writes beyond
+                # its mapped blocks hit sentinel entries and drop)
+                row = jax.lax.dynamic_slice_in_dim(cache.table, lane, 1, axis=0)
+                logits, lane_cache = llama_forward(
+                    cfg,
+                    params,
+                    tokens[None, :],
+                    positions[None, :],
+                    PagedKVCache(k=cache.k, v=cache.v, table=row),
+                    emulate_q80_activations=q80,
+                    mesh=sp_mesh,
+                    q80_sync=q80s,
+                )
+                out_cache = PagedKVCache(
+                    k=lane_cache.k, v=lane_cache.v, table=cache.table
+                )
+            else:
+                # slice this lane's cache to batch-of-1
+                k_lane = jax.lax.dynamic_slice_in_dim(cache.k, lane, 1, axis=1)
+                v_lane = jax.lax.dynamic_slice_in_dim(cache.v, lane, 1, axis=1)
+                logits, lane_cache = llama_forward(
+                    cfg,
+                    params,
+                    tokens[None, :],
+                    positions[None, :],
+                    KVCache(k=k_lane, v=v_lane),
+                    emulate_q80_activations=q80,
+                    mesh=sp_mesh,
+                    q80_sync=q80s,
+                )
+                k = jax.lax.dynamic_update_slice_in_dim(cache.k, lane_cache.k, lane, axis=1)
+                v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)
+                out_cache = KVCache(k=k, v=v)
             last = jax.lax.dynamic_index_in_dim(logits[0], n_tokens - 1, axis=0, keepdims=False)
             greedy = jnp.argmax(last).astype(jnp.int32)
             # same runtime gate as the decode families: a greedy admission
@@ -635,7 +738,7 @@ class InferenceEngine:
                 ),
                 lambda: greedy,
             )
-            return last, greedy, sampled, KVCache(k=k, v=v)
+            return last, greedy, sampled, out_cache
 
         @partial(jax.jit, donate_argnums=(1,))
         def _prefill(params, cache, lane, tokens, start_pos, n_tokens,
@@ -725,6 +828,24 @@ class InferenceEngine:
                 k=cache.k.at[:, dst].set(k_src),
                 v=cache.v.at[:, dst].set(v_src),
             )
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _copy_page(cache, src, dst):
+            # single-page HBM copy — the paged path's copy-on-write unit
+            # (page_size tokens x all layers, vs _copy_lane's whole-lane
+            # move): traced scalars mean ONE compile for any (src, dst)
+            # pair. Slots past the divergence point carry the source's
+            # stale content, which the tail prefill rewrites before any
+            # query can read them (the chunked-prefill invariant).
+            k_src = jax.lax.dynamic_index_in_dim(cache.k, src, axis=1, keepdims=False)
+            v_src = jax.lax.dynamic_index_in_dim(cache.v, src, axis=1, keepdims=False)
+            return PagedKVCache(
+                k=cache.k.at[:, dst].set(k_src),
+                v=cache.v.at[:, dst].set(v_src),
+                table=cache.table,
+            )
+
+        self._copy_page_fn = _copy_page
 
         def _make_decode_multi(h):
             @partial(jax.jit, donate_argnums=(1,))
@@ -1583,18 +1704,121 @@ class InferenceEngine:
             self.stats.host_bytes_in += out.nbytes
         return out
 
-    def copy_lane(self, src: int, dst: int) -> None:
+    def copy_lane(self, src: int, dst: int,
+                  prefix_len: int | None = None) -> None:
         """Copy lane ``src``'s whole KV cache into lane ``dst`` (prefix
-        caching: a new request sharing a prompt prefix with tokens already
-        resident in ``src`` skips prefilling that prefix — the scheduler
-        tracks which tokens each lane's cache holds and calls this before
-        prefilling only the tail). No reference analogue: its lanes share
-        one cache (defect (c)), so prefix reuse is impossible there."""
-        if src == dst:
-            return
+        caching on the CONTIGUOUS layout: a new request sharing a prompt
+        prefix with tokens already resident in ``src`` skips prefilling
+        that prefix — the scheduler tracks which tokens each lane's cache
+        holds and calls this before prefilling only the tail). No
+        reference analogue: its lanes share one cache (defect (c)), so
+        prefix reuse is impossible there.
+
+        ``prefix_len`` (when the caller knows it) lets a zero-length
+        share short-circuit like ``src == dst`` does: both used to
+        rebuild the whole cache pytree for a copy that moves nothing.
+        Paged engines refuse outright — sharing there is a refcount bump
+        on the SAME physical pages (``paged_admit``), and a whole-lane
+        HBM copy is exactly the cost the paged layout exists to avoid."""
+        if self.kvpool is not None:
+            raise RuntimeError(
+                "copy_lane is the contiguous layout's primitive; a paged "
+                "engine shares prefix pages by refcount via paged_admit"
+            )
+        if src == dst or prefix_len == 0:
+            return  # nothing would move: skip the whole-cache rebuild
         self.cache = self._copy_lane_fn(
             self.cache, jnp.int32(src), jnp.int32(dst)
         )
+
+    # -- paged KV pool (runtime/kvpool.py): the host/device seam ------------
+
+    def _paged_table_row(self, blocks) -> np.ndarray:
+        """A lane's page-table row (the pool's shared encoding recipe,
+        ``kvpool.table_row``) as the int32 device-leaf dtype."""
+        # dlint: ok[host-sync] host int list -> numpy row; no device value involved
+        return np.asarray(self.kvpool.table_row(list(blocks)), np.int32)
+
+    def _table_leaf(self):
+        """The host table mirror as the cache pytree's table leaf. On a
+        mesh the leaf must carry the SAME replicated NamedSharding the
+        cache was initialized with — make_array_from_callback builds it
+        from each process's (identical) host mirror, so it works on
+        multi-process pods where the mesh is not fully addressable."""
+        if self._table_sharding is None:
+            return jnp.asarray(self._host_tables)
+        return jax.make_array_from_callback(
+            self._host_tables.shape, self._table_sharding,
+            lambda idx: self._host_tables[idx],
+        )
+
+    def apply_paged_admit(self, lane: int, row, copies) -> None:
+        """Device half of a paged admission (or release): apply the COW
+        page ``copies`` then ship lane ``lane``'s new table ``row`` — both
+        thread the donated cache pytree, so they are ordered BEFORE any
+        later-dispatched tail prefill/decode by construction. Split from
+        ``paged_admit`` so pod workers can replay it from OP_KV_TABLE
+        packets while the pool bookkeeping stays root-only."""
+        for src, dst in copies:
+            self.cache = self._copy_page_fn(
+                self.cache, jnp.int32(src), jnp.int32(dst)
+            )
+        self._host_tables[lane] = row
+        # a table update between dispatches is just a new pytree leaf
+        # (host->device, a few KB of int32 — never a device sync)
+        self.cache = self.cache._replace(table=self._table_leaf())
+
+    def paged_admit(self, lane: int, tokens, reserve_tokens: int,
+                    min_share_tokens: int = 1) -> int:
+        """Reserve lane ``lane``'s pages for a request (prompt ``tokens``,
+        whole potential range ``reserve_tokens``) and apply the device
+        half. Returns ``start`` — prompt tokens already resident via the
+        prefix tree (refcount bumps on SHARED pages, zero HBM copies,
+        plus at most one single-page COW at the divergent block); the
+        caller prefills only ``tokens[start:]``. Raises
+        :class:`~.kvpool.PoolExhausted` when the pool cannot serve the
+        reservation even after evicting parked sessions."""
+        start, blocks, copies = self.kvpool.admit(
+            lane, tokens, reserve_tokens, min_share_tokens
+        )
+        self.apply_paged_admit(lane, self._paged_table_row(blocks), copies)
+        return start
+
+    def paged_commit(self, lane: int, tokens) -> None:
+        """Register lane ``lane``'s committed history into the prefix tree
+        (host bookkeeping only — the KV bytes are already on device)."""
+        self.kvpool.commit(lane, tokens)
+
+    def paged_finish(self, lane: int, park: bool = True) -> None:
+        """Release lane ``lane``'s pages at request end. ``park=True``
+        keeps its tree-registered blocks resident (refcounted, LRU-
+        bounded) so follow-ups and same-prompt admissions share copy-free;
+        ``park=False`` frees everything (failure path). The lane's table
+        row resets to all-unmapped — skipped entirely when the lane never
+        mapped anything (the exhaustion-shed reject path), so overload
+        rejects stay host-only cheap."""
+        if self.kvpool.finish(lane, park=park):
+            self.apply_paged_admit(lane, self._paged_table_row([]), [])
+
+    def paged_unmap_all(self) -> None:
+        """Device half of the paged reset: every lane's table row goes
+        all-unmapped. Split from :meth:`paged_reset` so pod workers can
+        replay it from an OP_KV_TABLE reset packet (lane == -1) while the
+        pool bookkeeping stays root-only."""
+        self._host_tables[:] = self.kvpool.table_row([])
+        self.cache = self.cache._replace(table=self._table_leaf())
+
+    def paged_reset(self) -> None:
+        """Containment: after an engine-scoped failure the device pool
+        contents are not trusted — drop every mapping, parked session and
+        tree node, and unmap every lane's table row."""
+        self.kvpool.reset()
+        self.paged_unmap_all()
+
+    def pool_stats(self) -> dict:
+        """Page-pool pressure snapshot for /stats (bridged to /metrics);
+        ``{}`` on contiguous engines."""
+        return self.kvpool.stats() if self.kvpool is not None else {}
 
     def reset_lane(self, lane: int) -> None:
         """Nothing to clear on device: a fresh request's prefill rewrites the
@@ -1607,10 +1831,10 @@ def warmup_engine(
     """Compile every serving program up front (each prefill bucket, decode
     with AND without the logits output, the speculative verify step, every
     multi-step horizon bucket the scheduler can pick, the pipelined step,
-    and the fused prefill+decode step per bucket) so the first real
-    request doesn't pay XLA compiles mid-service — the analogue of the
-    reference finishing its executor build before accepting connections
-    (src/app.cpp:233-312).
+    the fused prefill+decode step per bucket, and — paged engines — the
+    single-page COW copy) so the first real request doesn't pay XLA
+    compiles mid-service — the analogue of the reference finishing its
+    executor build before accepting connections (src/app.cpp:233-312).
 
     Deliberately a FREE function driving the PUBLIC engine API: on a
     multi-host pod root the proxy's decode/prefill_chunk broadcast control
@@ -1679,6 +1903,20 @@ def warmup_engine(
                             p_lane=0, chunk=[0] * bucket, tokens=z,
                         )
                         engine.pipeline_flush()
+        pool = getattr(engine, "kvpool", None)
+        apply_paged = getattr(engine, "apply_paged_admit", None)
+        if pool is not None and apply_paged is not None:
+            # the single-page COW program: the first divergent-block
+            # admission must not eat an XLA compile mid-service. Page 0
+            # onto itself copies zeros over zeros through the real
+            # program, and the all-sentinel row leaves lane 0's table in
+            # its initial unmapped state (pod roots broadcast via the
+            # RootControlEngine override so workers compile too)
+            apply_paged(
+                0,
+                np.full(pool.blocks_per_lane, pool.n_pages, np.int32),
+                [(0, 0)],
+            )
     # pod roots: drop the replayed warmup traffic from worker counters too
     reset_workers = getattr(engine, "reset_worker_stats", None)
     if reset_workers is not None:

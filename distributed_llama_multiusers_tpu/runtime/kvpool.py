@@ -1,0 +1,631 @@
+"""Paged KV pool: fixed-size KV pages + a ref-counted cross-request
+prefix tree — the host-side bookkeeping half of paged attention.
+
+The contiguous layout binds every resident session to one physical lane
+plane (``[n_lanes, seq_len, ...]``): a session's KV footprint is seq_len
+slots whether it uses them or not, prefix reuse is a whole-lane HBM copy
+(``engine.copy_lane``), and finished sessions stay warm only until a new
+request happens to claim their lane. This module virtualizes that: the
+device holds ONE pool of fixed-size pages (``page_size`` tokens each,
+power of two, every layer's K/V for those tokens), each lane maps to
+physical pages through a page table, and this class owns the host truth —
+the free list, per-page refcounts, and a prefix tree keyed on
+token-block content so N concurrent requests sharing a system prompt map
+their prefix blocks to the SAME physical pages with zero copies.
+
+Core rules:
+
+- **Granularity** — only FULL blocks enter the tree (a block's content is
+  immutable once committed: writes land strictly past the committing
+  lane's watermark, so shared pages are never write targets). A partial
+  match at the first divergent block is served copy-on-write: ONE page is
+  copied (``engine``-side device op, ~page_size tokens x layers — vs
+  copy_lane's whole-lane move) and the tail prefill rewrites it from the
+  divergence point before any query can read the stale slots.
+- **Reservation** — admission charges the lane's whole potential range
+  (prompt + max_tokens, clamped to seq_len) up front, so the pipelined
+  loop never needs a mid-chain allocation (the device advances positions
+  by per-lane spec accept counts the host only learns one step behind —
+  a lazy allocator could not keep up without a sync). Unused reserved
+  pages return at finish.
+- **Parking** — a finished session parks: its tree-registered blocks stay
+  resident (refcounted) so chat follow-ups and same-prompt admissions
+  hit copy-free, while its non-sharable tail pages free immediately.
+  Parked sessions are LRU-evicted under pool pressure (an admission that
+  cannot be served from the free list evicts before it sheds): dropped
+  sessions rebuild deterministically on next activity by re-prefilling
+  from the journaled prompt tokens — resident sessions are bounded by
+  journal bytes, not HBM.
+- **Exhaustion** — when eviction cannot cover an admission either, the
+  pool raises :class:`PoolExhausted`; the scheduler sheds the request
+  with a typed retryable 429 (``AdmissionRejected("pool_exhausted")``)
+  instead of corrupting another session's pages.
+
+Safety against in-flight junk writes (the pipelined ring dispatches up
+to ``depth`` steps past a stop the host has not consumed yet): every
+device mutation threads the one donated cache pytree, so all page writes
+are totally ordered by dispatch. A freed page re-allocated to a new lane
+is only ever READ by that lane after the lane's own (later-dispatched)
+writes covered the read frontier, and shared pages only expose content
+below the committing session's watermark — the same
+overwrite-before-readable invariant the contiguous path relies on.
+
+Pure host/stdlib (no jax): the device half (pool arrays, page tables,
+the page-copy program) lives in :mod:`runtime.engine`; the scheduler-
+level oversubscription tests run this class under MockAsyncEngine
+without a backend.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from itertools import islice
+
+from ..lockcheck import make_lock
+
+# root key of the prefix tree; node keys are (parent_key, block_tokens)
+# tuples, so the dict hash IS the block-content hash chain and two
+# different prefixes can never collide into one node
+_ROOT = ()
+
+DEFAULT_PAGE_SIZE = 64
+DEFAULT_MAX_PARKED = 64
+# how many sibling blocks the divergent-block COW probe scans (the tree
+# fans out per distinct block content; an unbounded scan under the pool
+# lock would let adversarial traffic make every admission O(children))
+_COW_SCAN_CAP = 16
+
+
+class PoolExhausted(RuntimeError):
+    """Admission could not reserve its pages: even evicting every parked
+    session would not free enough — the pool is pinned by active lanes.
+    Raised WITHOUT evicting (the parked prefix cache survives the shed,
+    so retrying 429 clients cannot hold it empty under pressure). The
+    scheduler maps this to a typed retryable shed (HTTP 429), never a
+    500."""
+
+    def __init__(self, need: int, free: int, total: int):
+        self.pages_needed = need
+        self.pages_free = free
+        self.pages_total = total
+        super().__init__(
+            f"kv page pool exhausted: admission needs {need} pages, "
+            f"{free}/{total} free and parked-session eviction cannot "
+            "cover the rest"
+        )
+
+
+def blocks_for(n_tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``n_tokens`` KV slots."""
+    return max(0, -(-int(n_tokens) // int(page_size)))
+
+
+class KVPagePool:
+    """Host bookkeeping for a device-resident paged KV pool.
+
+    All mutation happens on the scheduler loop thread; ``stats()`` is
+    read from HTTP threads — every access holds ``_lock`` (machine-
+    checked via ``_dlint_guarded_by``). The pool never touches a device
+    value: ``admit`` returns the physical block list + the page-copy ops
+    for the ENGINE to apply (and, on a pod root, to broadcast)."""
+
+    # dlint guarded-by declaration (analysis/lock_check.py): all pool
+    # state may only be touched holding `_lock` (or in __init__ /
+    # *_locked methods). Machine-checked by `make lint`.
+    _dlint_guarded_by = {
+        ("_lock",): (
+            "_free", "_ref", "_nodes", "_page_key", "_children",
+            "_lane_blocks", "_lane_reg", "_lane_tip",
+            "_parked", "_parked_pages", "_park_refs", "_park_seq",
+            "_park_index",
+            "admits", "prefix_admits", "prefix_tokens_shared",
+            "cow_copies", "parked_evicted", "exhausted_sheds",
+            "parked_total", "pool_resets",
+        ),
+    }
+
+    def __init__(
+        self,
+        n_pages: int,
+        page_size: int = DEFAULT_PAGE_SIZE,
+        n_lanes: int = 8,
+        blocks_per_lane: int | None = None,
+        max_parked: int = DEFAULT_MAX_PARKED,
+    ):
+        if page_size <= 0 or (page_size & (page_size - 1)) != 0:
+            raise ValueError(
+                f"page_size must be a power of two, got {page_size}"
+            )
+        if n_pages <= 0:
+            raise ValueError(f"n_pages must be positive, got {n_pages}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.n_lanes = int(n_lanes)
+        # table width: how many blocks one lane can map (defaults to a
+        # full-seq_len lane's worth when the engine builds the pool)
+        self.blocks_per_lane = int(blocks_per_lane or n_pages)
+        self.max_parked = max(0, int(max_parked))
+        # built via make_lock so the runtime lock-order witness
+        # (DLLAMA_LOCKCHECK=1) can wrap it; literal cross-checked by dlint
+        self._lock = make_lock("KVPagePool._lock")
+        # LIFO free stack: recently freed pages are re-used first (their
+        # device buffers are the most likely to still be resident-hot)
+        self._free: list[int] = list(range(self.n_pages))
+        self._ref = [0] * self.n_pages
+        # prefix tree: node key -> physical page; key = (parent_key,
+        # tuple(block tokens)) chains content, so a lookup walk is one
+        # dict get per block. _children mirrors it parent-first for the
+        # divergent-block COW probe; _page_key inverts it for removal
+        # when a page's refcount hits zero.
+        self._nodes: dict[tuple, int] = {}
+        self._page_key: dict[int, tuple] = {}
+        self._children: dict[tuple, dict[tuple, int]] = {}
+        # per-lane mapping: physical pages in block order, how many
+        # blocks the lane has registered into the tree, and the tree key
+        # of its registration tip (the chain grows from there)
+        self._lane_blocks: list[list[int]] = [[] for _ in range(self.n_lanes)]
+        self._lane_reg = [0] * self.n_lanes
+        self._lane_tip: list[tuple] = [_ROOT for _ in range(self.n_lanes)]
+        # parked sessions: park id -> registered block list; OrderedDict
+        # order IS the LRU (oldest first). _parked_pages counts DISTINCT
+        # physical pages pinned by parking (shared pages once, not once
+        # per holder — the gauge means real pool occupancy, and LOWER
+        # pages-per-parked-session = more overlap); _park_refs is the
+        # per-page park-hold count behind that dedup.
+        self._parked: "OrderedDict[int, list[int]]" = OrderedDict()
+        self._park_refs: dict[int, int] = {}
+        # block-list identity -> park id: a re-park of an IDENTICAL
+        # chain refreshes recency in one slot instead of flooding the
+        # LRU with duplicate holders of the same pages (one repetitive
+        # client would otherwise evict every other parked prefix)
+        self._park_index: dict[tuple, int] = {}
+        self._parked_pages = 0
+        self._park_seq = 0
+        # counters (stats() snapshots them for /stats -> /metrics)
+        self.admits = 0
+        self.prefix_admits = 0
+        self.prefix_tokens_shared = 0
+        self.cow_copies = 0
+        self.parked_evicted = 0  # drop-rebuild: sessions whose pages were
+        # reclaimed under pressure; their next activity re-prefills from
+        # the journaled prompt (deterministically byte-identical)
+        self.exhausted_sheds = 0
+        self.parked_total = 0
+        self.pool_resets = 0
+
+    @classmethod
+    def for_seq_len(
+        cls,
+        seq_len: int,
+        n_lanes: int,
+        page_size: int = DEFAULT_PAGE_SIZE,
+        pool_pages: int | None = None,
+        max_parked: int = DEFAULT_MAX_PARKED,
+    ) -> "KVPagePool":
+        """THE pool-construction recipe, shared by the real engine and
+        MockAsyncEngine's paged mode so the two cannot drift: validate
+        the page size (power of two), shrink it to fit short contexts
+        (tiny test configs) while staying a power of two, and default
+        the pool to the contiguous layout's exact footprint
+        (``n_lanes`` x blocks-per-full-lane) — oversubscription comes
+        from sessions reserving only what they can use, never from a
+        bigger pool. Callers derive the device/table shapes from the
+        result (``page_size``, ``blocks_per_lane``, ``n_pages``)."""
+        bs = int(page_size)
+        if bs <= 0 or bs & (bs - 1):
+            raise ValueError(
+                f"kv_page_size must be a positive power of two, "
+                f"got {page_size}"
+            )
+        while bs > seq_len:
+            bs //= 2
+        n_blocks = blocks_for(seq_len, bs)
+        # None = not set (contiguous-footprint default); an explicit 0 or
+        # negative must die in __init__'s validation, not silently become
+        # the default pool
+        n_pages = int(n_lanes * n_blocks if pool_pages is None
+                      else pool_pages)
+        return cls(n_pages, bs, n_lanes, blocks_per_lane=n_blocks,
+                   max_parked=max_parked)
+
+    # -- admission -----------------------------------------------------------
+
+    def admit(
+        self,
+        lane: int,
+        tokens: list[int],
+        reserve_tokens: int,
+        min_share_tokens: int = 1,
+    ) -> tuple[int, list[int], list[tuple[int, int]]]:
+        """Reserve lane ``lane``'s pages for a request whose prompt is
+        ``tokens`` and whose whole potential range is ``reserve_tokens``
+        KV slots. Returns ``(start, blocks, copies)``:
+
+        - ``start`` — prompt tokens already resident via sharing: full
+          blocks by refcount bump, plus up to one partial block served
+          copy-on-write. The caller prefills only ``tokens[start:]``
+          (always >= 1 token, the prefix-cache rule).
+        - ``blocks`` — the lane's physical pages in block order (shared
+          prefix pages first), for the device page table.
+        - ``copies`` — ``(src_page, dst_page)`` device copies the engine
+          must apply BEFORE the tail prefill (the COW at the divergent
+          block; at most one).
+
+        ``min_share_tokens`` gates sharing like the contiguous path's
+        ``prefix_min_tokens`` (<= 0 disables sharing entirely). Raises
+        :class:`PoolExhausted` when the reservation cannot be served
+        even after evicting every parked session."""
+        with self._lock:
+            self._release_locked(lane)  # defensive: lane must start empty
+            bs = self.page_size
+            max_reuse = len(tokens) - 1  # >= 1 token must prefill
+            shared_pages: list[int] = []
+            key = _ROOT
+            if min_share_tokens > 0:
+                while (len(shared_pages) + 1) * bs <= max_reuse:
+                    blk = tuple(tokens[len(shared_pages) * bs:
+                                       (len(shared_pages) + 1) * bs])
+                    page = self._nodes.get((key, blk))
+                    if page is None:
+                        break
+                    key = (key, blk)
+                    shared_pages.append(page)
+            start = len(shared_pages) * bs
+            # divergent-block COW probe: the best sibling block sharing a
+            # leading run with our next (possibly partial) block
+            cow_src = -1
+            cow_len = 0
+            if min_share_tokens > 0 and start < max_reuse:
+                want = tokens[start: min(start + bs, max_reuse)]
+                kids = self._children.get(key)
+                if kids and want:
+                    # islice, not a list copy: the cap exists so wide
+                    # fan-out can't make admissions O(children) under
+                    # the pool lock — copying the dict first would
+                    for blk, page in islice(kids.items(), _COW_SCAN_CAP):
+                        p = 0
+                        lim = min(len(blk), len(want))
+                        while p < lim and blk[p] == want[p]:
+                            p += 1
+                        if p > cow_len:
+                            cow_src, cow_len = page, p
+            if start + cow_len < max(1, min_share_tokens):
+                # below the sharing threshold: admit fully private (key
+                # included — a stale tip would make commit() register
+                # this lane's blocks under the matched chain, poisoning
+                # future walks with wrong-position KV)
+                shared_pages = []
+                start = 0
+                cow_src, cow_len = -1, 0
+                key = _ROOT
+            n_blocks = blocks_for(
+                max(reserve_tokens, len(tokens) + 1), bs
+            )
+            n_blocks = min(n_blocks, self.blocks_per_lane)
+            if n_blocks > self.n_pages:
+                # structurally unservable (an explicitly undersized
+                # --kv-pool-pages): even with every parked session and
+                # every other lane evicted the pool cannot hold this
+                # reservation, so the retryable PoolExhausted shed would
+                # have the client back off and re-probe forever — each
+                # probe destructively evicting parked prefixes. ValueError
+                # is the scheduler's request-scoped validation class
+                # (client error, breaker closed); raised BEFORE any
+                # ref/eviction side effect.
+                raise ValueError(
+                    f"kv page reservation needs {n_blocks} pages but the "
+                    f"pool holds {self.n_pages} total: lower the "
+                    "request's max_tokens/prompt or raise --kv-pool-pages"
+                )
+            need = n_blocks - len(shared_pages)
+            # take the shared refs (and a COW-source pin) BEFORE any
+            # eviction: the parked holders may be the ONLY refs on the
+            # pages this admission matched, and evicting them would free
+            # pages we are about to map (the free-list pop could then
+            # hand the same physical page back as a fresh block)
+            for p in shared_pages:
+                self._ref[p] += 1
+            cow_pinned = cow_src >= 0
+            if cow_pinned:
+                self._ref[cow_src] += 1
+            if len(self._free) < need:
+                # evict only when eviction can actually serve this
+                # admission: a shed that had first drained the parked LRU
+                # would leave retrying 429 clients holding the prefix
+                # cache empty for as long as the pool stays pinned — the
+                # retry-probe destruction the structural guard above
+                # stops for need > n_pages, generalized to transient
+                # pressure. A page is evictable iff park holds are its
+                # ONLY refs (shared/pinned pages stay resident anyway).
+                evictable = sum(
+                    1 for p, held in self._park_refs.items()
+                    if self._ref[p] == held
+                )
+                if len(self._free) + evictable < need:
+                    self.exhausted_sheds += 1
+                    for p in shared_pages:  # undo before shedding
+                        self._deref_locked(p)
+                    if cow_pinned:
+                        self._deref_locked(cow_src)
+                    raise PoolExhausted(
+                        need, len(self._free), self.n_pages
+                    )
+                self._evict_parked_locked(need - len(self._free))
+            if len(self._free) < need:
+                # backstop (the sufficiency check above should make this
+                # unreachable): never hand out a short reservation
+                self.exhausted_sheds += 1
+                for p in shared_pages:
+                    self._deref_locked(p)
+                if cow_pinned:
+                    self._deref_locked(cow_src)
+                raise PoolExhausted(
+                    need, len(self._free), self.n_pages
+                )
+            fresh = [self._free.pop() for _ in range(need)]
+            for p in fresh:
+                self._ref[p] = 1
+            if cow_pinned:
+                # the pin only had to survive eviction: the device copy
+                # is dispatched synchronously with this admission, before
+                # any later admission's writes can reuse the page
+                self._deref_locked(cow_src)
+            copies: list[tuple[int, int]] = []
+            if cow_src >= 0 and cow_len > 0 and fresh:
+                copies.append((cow_src, fresh[0]))
+                start += cow_len
+                self.cow_copies += 1
+            blocks = shared_pages + fresh
+            self._lane_blocks[lane] = blocks
+            self._lane_reg[lane] = len(shared_pages)
+            self._lane_tip[lane] = key
+            self.admits += 1
+            if start > 0:
+                self.prefix_admits += 1
+                self.prefix_tokens_shared += start
+            return start, list(blocks), copies
+
+    def commit(self, lane: int, tokens: list[int]) -> None:
+        """Register lane ``lane``'s newly completed full blocks into the
+        prefix tree. ``tokens`` is the lane's committed history (prompt +
+        consumed generated tokens); idempotent and incremental — call it
+        after every commit point, it only walks blocks not yet
+        registered. Duplicate content (another session registered the
+        identical chain first) keeps the existing node: future sharers
+        land on the first copy, ours stays private until it frees."""
+        with self._lock:
+            bs = self.page_size
+            blocks = self._lane_blocks[lane]
+            reg = self._lane_reg[lane]
+            n_full = len(tokens) // bs
+            key = self._lane_tip[lane]
+            while reg < n_full and reg < len(blocks):
+                blk = tuple(tokens[reg * bs: (reg + 1) * bs])
+                child = (key, blk)
+                if child not in self._nodes:
+                    page = blocks[reg]
+                    self._nodes[child] = page
+                    self._page_key[page] = child
+                    self._children.setdefault(key, {})[blk] = page
+                key = child
+                reg += 1
+            self._lane_reg[lane] = reg
+            self._lane_tip[lane] = key
+
+    # -- release / parking ---------------------------------------------------
+
+    def finish(self, lane: int, park: bool = True) -> bool:
+        """Release lane ``lane``'s mapping at request end. ``park=True``
+        keeps the session's tree-registered blocks resident (refcounted,
+        LRU-bounded) so follow-ups share copy-free, and frees the
+        non-sharable tail (partial block + unused reservation)
+        immediately; a re-park of an IDENTICAL chain refreshes the
+        existing entry's recency instead of adding a duplicate holder
+        (one repetitive client occupies one LRU slot, not max_parked);
+        blocks another lane registered first (duplicate content) back no
+        tree node and free rather than park as dead residency;
+        ``park=False`` frees everything (the failure path — the cache
+        contents are not trusted). Returns whether the lane actually
+        held pages: callers skip the device-side table unmap (and, on
+        pods, the OP_KV_TABLE broadcast) otherwise — the exhaustion-
+        shed reject path releases lanes that never mapped anything, and
+        overload rejects must stay host-only cheap."""
+        with self._lock:
+            blocks = self._lane_blocks[lane]
+            if not blocks:
+                self._clear_lane_locked(lane)
+                return False
+            keep: list[int] = []
+            if park and self.max_parked > 0:
+                for p in blocks[: self._lane_reg[lane]]:
+                    if p in self._page_key:
+                        keep.append(p)
+                    else:
+                        # duplicate-content block: another lane registered
+                        # the identical chain first, so this page backs no
+                        # tree node — no future walk can reach it, and
+                        # parking it would be dead residency that evicts
+                        # genuinely sharable sessions under pressure
+                        self._deref_locked(p)
+                for p in blocks[self._lane_reg[lane]:]:
+                    self._deref_locked(p)
+            else:
+                for p in blocks:
+                    self._deref_locked(p)
+            if keep:
+                existing = self._park_index.get(tuple(keep))
+                if existing is not None:
+                    # identical chain already parked: refresh its LRU
+                    # recency and release the lane's (now redundant)
+                    # refs — the existing entry's park holds pin the
+                    # pages, and a repeat client occupies ONE slot
+                    self._parked.move_to_end(existing)
+                    for p in keep:
+                        self._deref_locked(p)
+                else:
+                    self._park_seq += 1
+                    self._parked[self._park_seq] = keep
+                    self._park_index[tuple(keep)] = self._park_seq
+                    for p in keep:
+                        if self._park_refs.get(p, 0) == 0:
+                            self._parked_pages += 1
+                        self._park_refs[p] = self._park_refs.get(p, 0) + 1
+                    while len(self._parked) > self.max_parked:
+                        self._evict_oldest_locked()
+                self.parked_total += 1
+            self._clear_lane_locked(lane)
+            return True
+
+    def release(self, lane: int) -> None:
+        """Free lane ``lane``'s mapping without parking (idempotent)."""
+        with self._lock:
+            self._release_locked(lane)
+
+    def drop_parked(self) -> int:
+        """Evict every parked session (test/benchmark lever for the
+        park -> drop -> journal-rebuild round trip). Returns how many
+        sessions were dropped."""
+        with self._lock:
+            n = len(self._parked)
+            while self._parked:
+                self._evict_oldest_locked()
+            return n
+
+    def reset(self) -> None:
+        """Containment: drop every lane mapping, every parked session and
+        every tree node — after an engine-scoped failure the device pool
+        contents are not trusted, so nothing may be shared from them."""
+        with self._lock:
+            for lane in range(self.n_lanes):
+                self._clear_lane_locked(lane)
+            # parked sessions drain WITHOUT counting parked_evicted:
+            # that gauge means LRU pressure (drop-rebuild); containment
+            # is already counted by pool_resets
+            self._parked.clear()
+            # anything still referenced would be a bookkeeping leak: the
+            # reset is the last resort, start from a clean pool
+            self._nodes.clear()
+            self._page_key.clear()
+            self._children.clear()
+            self._free = list(range(self.n_pages))
+            self._ref = [0] * self.n_pages
+            self._park_refs.clear()
+            self._park_index.clear()
+            self._parked_pages = 0
+            self.pool_resets += 1
+
+    # -- introspection -------------------------------------------------------
+
+    def table_row(self, blocks: list[int]) -> list[int]:
+        """One lane's page-table row: physical pages in block order,
+        padded to ``blocks_per_lane`` with the ``n_pages`` unmapped
+        sentinel — THE row-encoding recipe, shared by the engine and
+        MockAsyncEngine so the sentinel value and layout cannot drift.
+        No lock: reads only immutable pool geometry."""
+        row = [self.n_pages] * self.blocks_per_lane
+        row[: len(blocks)] = blocks
+        return row
+
+    def lane_blocks(self, lane: int) -> list[int]:
+        with self._lock:
+            return list(self._lane_blocks[lane])
+
+    def pages_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def parked_sessions(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
+    def stats(self) -> dict:
+        """Point-in-time pool pressure snapshot (one lock hold); every
+        field is bridged to /metrics as a ``dllama_stats_*`` gauge via
+        the /stats bridge, so dashboards see pool pressure end-to-end."""
+        with self._lock:
+            return {
+                "pool_pages_total": self.n_pages,
+                "pool_pages_free": len(self._free),
+                "pool_page_size": self.page_size,
+                "pool_parked_sessions": len(self._parked),
+                "pool_parked_pages": self._parked_pages,
+                "pool_admits": self.admits,
+                "pool_prefix_admits": self.prefix_admits,
+                "pool_prefix_tokens_shared": self.prefix_tokens_shared,
+                "pool_cow_copies": self.cow_copies,
+                "pool_parked_evicted": self.parked_evicted,
+                "pool_exhausted_sheds": self.exhausted_sheds,
+                "pool_parked_total": self.parked_total,
+                "pool_resets": self.pool_resets,
+            }
+
+    # -- internals (callers hold _lock) --------------------------------------
+
+    def _clear_lane_locked(self, lane: int) -> None:
+        self._lane_blocks[lane] = []
+        self._lane_reg[lane] = 0
+        self._lane_tip[lane] = _ROOT
+
+    def _release_locked(self, lane: int) -> None:
+        for p in self._lane_blocks[lane]:
+            self._deref_locked(p)
+        self._clear_lane_locked(lane)
+
+    def _deref_locked(self, page: int) -> None:
+        self._ref[page] -= 1
+        if self._ref[page] > 0:
+            return
+        self._ref[page] = 0
+        # remove the tree node this page backs (if any): children whose
+        # parent chain just broke become unreachable for NEW matches but
+        # stay refcounted by their own holders and remove themselves the
+        # same way when their refs drain
+        key = self._page_key.pop(page, None)
+        if key is not None:
+            self._nodes.pop(key, None)
+            parent, blk = key
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.pop(blk, None)
+                if not kids:
+                    self._children.pop(parent, None)
+        self._free.append(page)
+
+    def _evict_entry_locked(self, pid: int) -> None:
+        blocks = self._parked.pop(pid)
+        self._park_index.pop(tuple(blocks), None)
+        for p in blocks:
+            held = self._park_refs.get(p, 0) - 1
+            if held <= 0:
+                self._park_refs.pop(p, None)
+                self._parked_pages -= 1
+            else:
+                self._park_refs[p] = held
+            self._deref_locked(p)
+        self.parked_evicted += 1
+
+    def _evict_oldest_locked(self) -> None:
+        self._evict_entry_locked(next(iter(self._parked)))
+
+    def _evict_parked_locked(self, short_by: int) -> None:
+        """Evict parked sessions in LRU order until at least ``short_by``
+        more pages are free, SKIPPING sessions that could free nothing —
+        every page still pinned by an active lane or the admitting
+        request's own shared-ref/COW pins (``ref > park holds`` on all of
+        them). Evicting those would destroy a park entry — typically the
+        very prefix the admission is sharing — while relieving zero
+        pressure, and if the sharing request later failed with
+        park=False the hot prefix would vanish from the tree for
+        nothing. Eviction frees a session's pages only where its
+        refcount drains to zero — blocks shared with an active lane
+        stay resident either way. The admit()-side sufficiency check
+        guarantees this pass reaches ``short_by`` whenever it runs."""
+        before = len(self._free)
+        for pid in list(self._parked):
+            if len(self._free) - before >= short_by:
+                break
+            if any(
+                self._ref[p] == self._park_refs.get(p, 0)
+                for p in self._parked[pid]
+            ):
+                self._evict_entry_locked(pid)

@@ -54,6 +54,7 @@ from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerCha
 from ..utils import faults
 from ..utils.seeds import fresh_seed
 from .engine import DEFAULT_TOPP
+from .kvpool import PoolExhausted
 from .spec import NgramDraftIndex
 
 
@@ -79,7 +80,14 @@ def classify_failure(e: BaseException) -> str:
       raise (XLA ``RESOURCE_EXHAUSTED``, transfer errors, injected
       faults): the pipeline flushes, affected lanes fail, lane state
       resets, and the loop keeps serving behind the circuit breaker.
+
+    ``AdmissionRejected`` (the paged pool's exhaustion shed) is request-
+    scoped despite being a RuntimeError: the pool being pinned by active
+    lanes is LOAD, not engine failure — the client gets the retryable
+    429/503 shape ``submit()`` sheds with, and the breaker stays closed.
     """
+    if isinstance(e, AdmissionRejected):
+        return "request"
     return "request" if isinstance(e, ValueError) else "engine"
 
 
@@ -575,6 +583,24 @@ class ContinuousBatchingScheduler:
     def _free_lane_indices(self) -> list[int]:
         return [i for i, l in enumerate(self._lanes) if l.request is None]
 
+    def _paged_commit(self, lane_idx: int) -> None:
+        """Register lane ``lane_idx``'s newly completed FULL blocks into
+        the paged pool's prefix tree (host dict walk, incremental — a
+        no-op for contiguous engines and for unfinished blocks). Called
+        wherever ``_lane_kv`` grows: commits only ever trail the
+        committed watermark, so shared pages are never write targets."""
+        if getattr(self.engine, "kvpool", None) is not None:
+            self.engine.paged_commit(lane_idx, self._lane_kv[lane_idx])
+
+    def _paged_release(self, lane_idx: int, park: bool) -> None:
+        """Release a lane's pages at request end: ``park=True`` keeps its
+        tree-registered blocks resident for copy-free follow-ups (the
+        oversubscription lever — resident sessions outnumber lanes),
+        ``park=False`` frees everything (failure path: contents are not
+        trusted). No-op for contiguous engines."""
+        if getattr(self.engine, "kvpool", None) is not None:
+            self.engine.paged_finish(lane_idx, park=park)
+
     def occupancy(self) -> tuple[int, int]:
         """(busy lanes, total lanes) — public surface for /stats."""
         return (
@@ -608,6 +634,12 @@ class ContinuousBatchingScheduler:
         stats = getattr(self.queue, "stats", None)
         if callable(stats):
             out.update(stats())
+        # paged KV pool pressure (occupancy, prefix sharing, COW,
+        # park/evict = drop-rebuild, exhaustion sheds): every field lands
+        # on /stats and is bridged to /metrics as a dllama_stats_* gauge
+        pool = getattr(self.engine, "pool_stats", None)
+        if callable(pool):
+            out.update(pool())
         return out
 
     def _on_watchdog_trip(self, waited_s: float) -> None:
@@ -664,6 +696,10 @@ class ContinuousBatchingScheduler:
         self._lanes[lane_idx] = _Lane()
         self._lane_kv[lane_idx] = []
         try:
+            # paged: free the lane's pages WITHOUT parking — after a
+            # failed dispatch the cache contents are unknown, and the
+            # prefix tree must never serve garbage
+            self._paged_release(lane_idx, park=False)
             self.engine.reset_lane(lane_idx)
         except Exception:  # noqa: BLE001 — containment must not throw
             pass
@@ -770,30 +806,64 @@ class ContinuousBatchingScheduler:
             tokens = tokens[-(max_ctx - req.max_tokens - 1) :] if max_ctx > req.max_tokens + 1 else tokens[-max_ctx + 1 :]
         req.n_prompt_tokens = len(tokens)
 
-        # prefix caching: if some lane's resident KV (finished lanes
-        # included — their cache persists until overwritten) shares a long
-        # enough prompt prefix, copy that lane's KV (an HBM move, orders of
-        # magnitude cheaper than prefill) and prefill only the tail. A chat
-        # follow-up landing on its own previous lane hits with src == dst,
-        # which copy_lane no-ops.
+        # prefix caching. Paged engines (engine.kvpool set): admission
+        # charges the lane's whole potential range in PAGES up front and
+        # the pool's prefix tree serves shared leading blocks by refcount
+        # bump on the SAME physical pages — zero HBM copies, plus at most
+        # one single-page copy-on-write at the divergent block. Contiguous
+        # engines: if some lane's resident KV (finished lanes included —
+        # their cache persists until overwritten) shares a long enough
+        # prompt prefix, copy that lane's KV (an HBM move, orders of
+        # magnitude cheaper than prefill) and prefill only the tail. A
+        # chat follow-up landing on its own previous lane hits with
+        # src == dst, which copy_lane no-ops.
         start = 0
-        if (
+        if getattr(self.engine, "kvpool", None) is not None:
+            # +1 reserves the slot the boundary token's own KV write needs
+            # when generation runs to max_tokens exactly
+            reserve = min(len(tokens) + req.max_tokens + 1, max_ctx)
+            try:
+                start = self.engine.paged_admit(
+                    lane_idx, list(tokens), reserve,
+                    min_share_tokens=self.prefix_min_tokens,
+                )
+            except PoolExhausted as e:
+                # typed retryable shed (the 429/503 + Retry-After shape
+                # submit() sheds with), never a 500: a pool pinned by
+                # active lanes is load, not engine failure. Counted on
+                # the QoS rejection surface like every other shed reason
+                # (queue_full/draining/breaker_open), so dashboards on
+                # the rejection counters see paged-pool sheds too.
+                note = getattr(self.queue, "note_rejection", None)
+                if note is not None:
+                    note("pool_exhausted")
+                raise AdmissionRejected(
+                    "pool_exhausted", retry_after_s=1.0
+                ) from e
+        elif (
             self.prefix_min_tokens > 0
             and getattr(self.engine, "copy_lane", None) is not None
         ):
             best_lane, best_lcp = -1, 0
             for j, kv in enumerate(self._lane_kv):
+                if not kv:
+                    # discarded resident map (_fail_request after a failed
+                    # dispatch, or a never-used lane): probing the dead
+                    # entry is wasted work and must never win the scan
+                    continue
                 lcp = _common_prefix_len(tokens, kv)
                 if lcp > best_lcp:
                     best_lane, best_lcp = j, lcp
             best_lcp = min(best_lcp, len(tokens) - 1)  # >= 1 token to prefill
             if best_lcp >= self.prefix_min_tokens:
-                self.engine.copy_lane(best_lane, lane_idx)
+                self.engine.copy_lane(best_lane, lane_idx,
+                                      prefix_len=best_lcp)
                 start = best_lcp
-                self.telemetry.on_prefix_hit(req, best_lcp)
-                with self.engine.stats.lock:
-                    self.engine.stats.prefix_hits += 1
-                    self.engine.stats.prefix_tokens_saved += best_lcp
+        if start > 0:  # one accounting site for both layouts
+            self.telemetry.on_prefix_hit(req, start)
+            with self.engine.stats.lock:
+                self.engine.stats.prefix_hits += 1
+                self.engine.stats.prefix_tokens_saved += start
         self._lane_kv[lane_idx] = list(tokens[:start])
 
         lane = self._lanes[lane_idx]
@@ -884,6 +954,7 @@ class ContinuousBatchingScheduler:
         lane.pos += len(chunk)
         lane.pending = lane.pending[len(chunk):]
         self._lane_kv[lane_idx].extend(chunk)  # committed: prefix-cacheable
+        self._paged_commit(lane_idx)
         if lane.pending:
             return True
         # prompt complete: pick the first generated token
@@ -924,6 +995,7 @@ class ContinuousBatchingScheduler:
         # that IS when their stream deltas reach the client)
         self.telemetry.on_token(req)
         self._lane_kv[lane_idx].append(tok)  # its KV write is committed
+        self._paged_commit(lane_idx)
         lane.drafter.append(tok)
         piece = lane.decoder.decode(tok)
         result = lane.eos.append(tok, piece)
@@ -1229,6 +1301,7 @@ class ContinuousBatchingScheduler:
         lane.pos += len(chunk)
         lane.pending = lane.pending[len(chunk):]
         self._lane_kv[target].extend(chunk)  # committed: prefix-cacheable
+        self._paged_commit(target)
         return (
             (target, lane, not lane.pending, len(chunk)),
             drafted if drafts is not None else None,
@@ -1518,6 +1591,11 @@ class ContinuousBatchingScheduler:
             if req.on_delta:
                 req.on_delta(delta)
         self._lanes[lane_idx] = _Lane()
+        # paged: the finished session PARKS — its tree-registered blocks
+        # stay resident (refcounted, LRU-bounded) so chat follow-ups and
+        # same-prompt admissions share copy-free; its non-sharable tail
+        # frees now. This is how resident sessions exceed lanes.
+        self._paged_release(lane_idx, park=True)
         self.engine.reset_lane(lane_idx)
         # summary/spans/log line BEFORE the future resolves: the HTTP
         # thread reads req.summary the moment result() returns
@@ -1601,6 +1679,15 @@ class ContinuousBatchingScheduler:
                 self._fail_request(i, req, err)
             except Exception:  # noqa: BLE001 — containment must not throw
                 pass
+        # paged: after an engine-scoped failure the device pool contents
+        # are not trusted — drop parked sessions and the whole prefix
+        # tree too, not just the failed lanes' mappings
+        try:
+            reset = getattr(self.engine, "paged_reset", None)
+            if reset is not None and getattr(self.engine, "kvpool", None) is not None:
+                reset()
+        except Exception:  # noqa: BLE001 — containment must not throw
+            pass
 
     def _resolve_exit(self) -> None:
         """The stop()/drain() future cleanup, in a ``finally`` so it runs

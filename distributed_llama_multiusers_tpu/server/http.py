@@ -258,10 +258,20 @@ class ApiServer:
             break  # ("done",): the future resolved
         try:
             req.future.result()  # re-raise failures
-        except AdmissionRejected:
-            # drain flushed this queued request after the SSE headers
-            # were committed — too late for a 503 status line, so end
-            # the stream with a terminal "cancelled" chunk instead
+        except AdmissionRejected as e:
+            # shed after the SSE headers were committed (drain flush, or
+            # the paged pool's post-submit pool_exhausted) — too late
+            # for the 429/503 status line, so the typed shed ships as an
+            # error chunk first: reason + Retry-After hint, or a stream
+            # client reads the empty "cancelled" terminal as the model's
+            # answer and never backs off or retries
+            send_chunk({
+                "error": str(e), "reason": e.reason,
+                "retry_after_s": round(
+                    jittered_retry_after(e.retry_after_s, req.id), 2
+                ),
+                "request_id": req.id,
+            })
             req.finish_reason = "cancelled"
         # terminal chunk carries the SAME per-request summary the
         # non-streaming response does (one producer: the scheduler's

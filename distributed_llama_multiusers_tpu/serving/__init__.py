@@ -23,7 +23,13 @@ from .deadlines import (
 )
 from .drain import drain_scheduler
 from .journal import JournalEntry, JournalImage, RequestJournal, read_journal
-from .qos import AdmissionRejected, Priority, QosQueue, jittered_retry_after
+from .qos import (
+    AdmissionRejected,
+    Priority,
+    QosQueue,
+    jittered_retry_after,
+    page_cost,
+)
 from .recovery import RecoveryCoordinator, recover_scheduler
 from .resume import StreamRegistry, StreamRelay
 from .watchdog import StepWatchdog

@@ -72,10 +72,12 @@ class AdmissionRejected(RuntimeError):
     """Typed load-shed signal: the request never entered the queue.
 
     ``reason`` is ``"queue_full"`` (bounded admission, HTTP 429),
-    ``"draining"`` (graceful shutdown in progress, HTTP 503), or
+    ``"draining"`` (graceful shutdown in progress, HTTP 503),
     ``"breaker_open"`` (the engine circuit breaker is shedding while the
-    engine is unhealthy, HTTP 503 — serving/breaker.py); all carry a
-    ``retry_after_s`` hint for the ``Retry-After`` header."""
+    engine is unhealthy, HTTP 503 — serving/breaker.py), or
+    ``"pool_exhausted"`` (the paged KV pool is pinned by active lanes,
+    HTTP 429 — runtime/kvpool.py); all carry a ``retry_after_s`` hint
+    for the ``Retry-After`` header."""
 
     def __init__(
         self,
@@ -98,6 +100,12 @@ class AdmissionRejected(RuntimeError):
                 "engine circuit breaker open (repeated engine failures); "
                 f"retry in ~{retry_after_s:.0f}s"
             )
+        elif reason == "pool_exhausted":
+            msg = (
+                "kv page pool exhausted (pinned by active requests; "
+                "see --kv-pool-pages/--kv-max-parked); "
+                f"retry in ~{retry_after_s:.0f}s"
+            )
         else:
             msg = (
                 f"queue full ({queue_depth}/{capacity} waiting); "
@@ -110,6 +118,37 @@ def _default_cost(req) -> float:
     """DRR cost of a request: its token demand (the decode-lane time it will
     hold), never below one so zero/absent max_tokens still consumes credit."""
     return float(max(1, getattr(req, "max_tokens", 1) or 1))
+
+
+def page_cost(page_size: int) -> Callable[[object], float]:
+    """DRR cost in KV PAGES for paged engines (runtime/kvpool.py): the
+    pages this request's admission will reserve — prompt + max_tokens
+    (+1 for the boundary token's KV write), rounded up to page granularity.
+
+    On the contiguous layout every admission costs one identical lane, so
+    token demand (decode time) is the only axis users can differ on. The
+    paged pool makes HBM itself the contended resource — a 10-page
+    admission displaces ten times the parked sessions a 1-page one does —
+    so fair share must charge what admission actually takes from the
+    pool, or one user's long-context requests would evict every other
+    user's parked prefixes at the same DRR price as a one-liner.
+
+    Cost is evaluated at POP time on queued requests, before
+    tokenization: ``n_prompt_tokens`` is used when a recovery replay or
+    an earlier pass already resolved it, otherwise the prompt's token
+    count is estimated at ~4 chars/token (the usual BPE density; the
+    estimate only orders the DRR rotation, admission itself charges the
+    exact reservation)."""
+    page_size = max(1, int(page_size))
+
+    def _cost(req) -> float:
+        prompt = int(getattr(req, "n_prompt_tokens", 0) or 0)
+        if prompt <= 0:
+            prompt = len(getattr(req, "prompt", "") or "") // 4 + 1
+        tokens = prompt + int(max(1, getattr(req, "max_tokens", 1) or 1)) + 1
+        return float(max(1, -(-tokens // page_size)))
+
+    return _cost
 
 
 def jittered_retry_after(seconds: float, key: int,

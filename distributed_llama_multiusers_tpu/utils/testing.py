@@ -82,6 +82,26 @@ class StubStreamTokenizer:
         return "x"
 
 
+class CharStreamTokenizer(StubStreamTokenizer):
+    """Char-level, prompt-DEPENDENT encoding for prefix-sharing
+    harnesses: shared text prefixes become shared token prefixes exactly
+    as long as they are (the base stub maps every prompt to the same
+    tokens, which would make any prefix probe a trivial full-prompt
+    hit). One home shared by tests/test_prefix_cache.py and bench.py's
+    serving_prefix phase, so the encoding the byte-identity tests pin
+    and the encoding the bench measures cannot drift. ``max_chars``
+    caps the prompt length in tokens (None = unbounded)."""
+
+    def __init__(self, vocab_size: int = 64, max_chars: int | None = None):
+        super().__init__(vocab_size)
+        self.max_chars = max_chars
+
+    def encode(self, text, add_bos=True, add_special_tokens=True):
+        if self.max_chars is not None:
+            text = text[: self.max_chars]
+        return [2 + ord(c) % (self.vocab_size - 2) for c in text]
+
+
 class MockAsyncEngine:
     """Engine stub modelling an ASYNC device for scheduler pipeline tests
     and the bench microbench: dispatch is free and advances a simulated
@@ -115,7 +135,8 @@ class MockAsyncEngine:
 
     def __init__(self, n_lanes=4, vocab=64, seq_len=4096, step_s=0.002,
                  pipeline_depth=2, max_chunk=16, speculative=False,
-                 content_keyed=False):
+                 content_keyed=False, paged=False, kv_page_size=16,
+                 kv_pool_pages=None, kv_max_parked=8):
         """``speculative=True`` opts this instance into the speculative
         families (``decode_spec`` + the in-chain
         ``decode_spec_pipelined`` / ``decode_spec_prefill_fused``),
@@ -134,7 +155,20 @@ class MockAsyncEngine:
         (sampling is per (seed, pos), greedy is per (model, prompt) —
         never per lane), which the crash-recovery chaos tests pin: a
         recovered request re-admitted onto a DIFFERENT lane must still
-        regenerate byte-identically."""
+        regenerate byte-identically.
+
+        ``paged=True`` mirrors the real engine's paged-KV contract
+        (runtime/kvpool.py — a pure-host module, so no jax is needed):
+        ``kvpool`` + ``paged_admit``/``paged_commit``/``paged_finish``/
+        ``paged_reset``/``pool_stats`` drive the REAL pool bookkeeping
+        (free list, refcounts, prefix tree, parking, exhaustion sheds)
+        and maintain the host page-table mirror; the only thing mocked
+        is the device half (table writes land in a numpy array, COW
+        copies just count). Combined with ``content_keyed``, a shared
+        prefix served by refcount reproduces the stream prefilling it
+        would have produced: ``paged_admit`` folds the SKIPPED prefix's
+        content into the lane stream key, so scheduler-level
+        oversubscription tests assert byte-identity without a backend."""
         import numpy as np
         import types
 
@@ -164,9 +198,60 @@ class MockAsyncEngine:
         self._sim_pos = np.zeros(n_lanes, np.int64)
         self._steps = 0
         self.events = []  # ("dispatch"|"consume", step_idx)
+        # paged KV mirror (the real engine's host half, device half mocked)
+        self.kvpool = None
+        if paged:
+            from ..runtime.kvpool import KVPagePool
+
+            # the REAL engine's construction recipe (validation, shrink,
+            # footprint default) — shared classmethod, so the mock's
+            # pool geometry provably cannot drift from the engine's
+            self.kvpool = KVPagePool.for_seq_len(
+                seq_len, n_lanes, page_size=kv_page_size,
+                pool_pages=kv_pool_pages, max_parked=kv_max_parked,
+            )
+            self._host_tables = np.asarray(
+                [self.kvpool.table_row([])] * n_lanes, np.int32
+            )
+            self.page_copies_applied = 0  # the mocked device COW half
 
     def max_chunk(self):
         return self._max_chunk
+
+    # -- paged KV (runtime/kvpool.py contract; device half mocked) ---------
+
+    def paged_admit(self, lane, tokens, reserve_tokens,
+                    min_share_tokens=1):
+        """The real engine's paged admission over the REAL pool
+        bookkeeping; raises the real :class:`~..runtime.kvpool.PoolExhausted`.
+        The device half is a numpy table write + a COW counter bump."""
+        start, blocks, copies = self.kvpool.admit(
+            lane, list(tokens), reserve_tokens, min_share_tokens
+        )
+        self._host_tables[int(lane)] = self.kvpool.table_row(blocks)
+        self.page_copies_applied += len(copies)
+        if self._content_keyed and start > 0:
+            # the shared prefix's KV is resident: fold its CONTENT into
+            # the lane stream key exactly as prefilling it would have, so
+            # a refcount-served prefix and a prefilled one are stream-
+            # indistinguishable (the byte-identity property under test)
+            self._lane_key[int(lane)] = 0
+            self._feed_key(lane, list(tokens[:start]), 0)
+        return start
+
+    def paged_commit(self, lane, tokens):
+        self.kvpool.commit(lane, list(tokens))
+
+    def paged_finish(self, lane, park=True):
+        if self.kvpool.finish(lane, park=park):
+            self._host_tables[int(lane)] = self.kvpool.table_row([])
+
+    def paged_reset(self):
+        self.kvpool.reset()
+        self._host_tables[:] = self.kvpool.table_row([])
+
+    def pool_stats(self):
+        return self.kvpool.stats() if self.kvpool is not None else {}
 
     def reset_lane(self, lane):
         pass

@@ -353,6 +353,91 @@ def test_guarded_by_flags_unlocked_telemetry_ring_access(tmp_path):
     assert "_trace_ring" in findings[0].message
 
 
+# -- paged KV pool (runtime/kvpool.py) ---------------------------------------
+
+
+def test_host_sync_covers_kvpool_file(tmp_path):
+    """PR-11 satellite: runtime/kvpool.py is registered under host-sync —
+    the pool bookkeeping runs inside the admission path
+    (scheduler._start_request -> engine.paged_admit) and is host
+    dicts/lists by contract; a device->host transfer construct added
+    there is a finding exactly like in runtime/."""
+    findings = run_on(tmp_path, {"runtime/kvpool.py": """
+        import numpy as np
+
+        class KVPagePool:
+            def admit(self, tokens):
+                return np.asarray(tokens)
+    """})
+    assert checks_of(findings) == ["host-sync"]
+    # the clean shape: pure host bookkeeping — block the prompt into
+    # content tuples, walk the tree dict, no transfer spelling anywhere
+    clean = run_on(tmp_path / "b", {"runtime/kvpool.py": """
+        class KVPagePool:
+            def blocks(self, tokens, bs):
+                return [
+                    tuple(tokens[i : i + bs])
+                    for i in range(0, len(tokens), bs)
+                ]
+    """})
+    assert clean == []
+
+
+def test_real_kvpool_guard_decls_are_collected():
+    """KVPagePool's free-list/refcount/prefix-tree declaration reaches
+    the guarded-by checker (the rot-guard pattern: the declaration
+    syntax must not silently rot out of collection)."""
+    import ast
+
+    from distributed_llama_multiusers_tpu.analysis.core import Project, SourceFile
+    from distributed_llama_multiusers_tpu.analysis.lock_check import GuardedByChecker
+
+    project = Project()
+    checker = GuardedByChecker()
+    p = PACKAGE_ROOT / "runtime/kvpool.py"
+    sf = SourceFile(
+        path=p, display="runtime/kvpool.py", text=p.read_text(),
+        tree=ast.parse(p.read_text()),
+    )
+    checker.collect(sf, project)
+    assert "_free" in project.guarded
+    assert "_nodes" in project.guarded
+    assert "_parked" in project.guarded
+    assert "cow_copies" in project.guarded
+    assert project.guarded["_free"][0] == frozenset({"_lock"})
+
+
+def test_guarded_by_flags_unlocked_kvpool_free_list(tmp_path):
+    """Known-bad: a pool free-list pop outside the lock (stats() races
+    the scheduler thread through exactly this state) is a finding;
+    the locked and *_locked-helper shapes stay clean."""
+    findings = run_on(tmp_path, {"runtime/kvpool.py": """
+        import threading
+
+        class KVPagePool:
+            _dlint_guarded_by = {("_lock",): ("_free", "_ref")}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._free = [0, 1, 2]
+                self._ref = [0, 0, 0]
+
+            def bad_alloc(self):
+                return self._free.pop()
+
+            def good_alloc(self):
+                with self._lock:
+                    page = self._free.pop()
+                    self._ref[page] = 1
+                    return page
+
+            def _deref_locked(self, page):
+                self._ref[page] -= 1
+    """})
+    assert checks_of(findings) == ["guarded-by"]
+    assert "_free" in findings[0].message
+
+
 # -- pipeline-sync -----------------------------------------------------------
 
 
