@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint lockgraph sanitize dryrun chaos clean
+.PHONY: all native test verify lint lockgraph sanitize dryrun chaos fleet clean
 
 all: native
 
@@ -67,6 +67,18 @@ dryrun:
 # tier-1 via `verify`.
 chaos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_failures.py tests/test_journal.py -q
+
+# Fleet gate (docs/SERVING.md "Fleet serving"): the multi-replica router
+# suite — routing under load (least-loaded wins, breaker-open replicas
+# excluded), prefix-affinity determinism with the consistent-hash 1/N
+# movement bound, typed shed handling, and THE pin: a live SSE stream
+# migrated off a dying replica is byte-identical with zero lost and zero
+# duplicated tokens vs the uninterrupted run. Mock-engine based: runs in
+# seconds, no accelerator. Run it before shipping fleet/, server/http.py
+# admin-endpoint, or recovery changes; the same tests ride tier-1 via
+# `verify`.
+fleet:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
 
 # Reviewer aid for new lock/broadcast code (ROADMAP items 2-4): the
 # statically computed lock-order DAG, DOT on stdout (waived edges

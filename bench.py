@@ -35,6 +35,13 @@ single phase can eat the budget:
                --recover-journal restart; reports resume-latency-ms,
                lost-token count (must be 0) and duplicate-token count
                (must be 0) for clients reattaching via Last-Event-ID
+  serving_fleet — the fleet gate: Poisson SSE traffic through the
+               dllama-router at 3 mock-backed replicas while one is
+               SIGTERM-drained and one is killed mid-run; reports
+               TTFT/TBT percentiles through the router, shed rate
+               (must be 0 — sheds are retried or migrated), affinity
+               hit rate, migration count + latency, and the loss
+               ledger (byte-identical, 0 lost / 0 duplicated)
   ablations  — packed Q40 via XLA dequant, dense bf16 (what the kernel buys)
   8b         — the BASELINE north star: Llama-3.1-8B Q40 decode tok/s vs
                200 tok/s/chip (BASELINE.md), now on by default
@@ -1466,6 +1473,246 @@ def _phase_serving_recovery(config, small):
     }
 
 
+def _phase_serving_fleet(config, small):
+    """The fleet gate (ISSUE 12): Poisson SSE traffic through the
+    ``dllama-router`` at THREE MockAsyncEngine-backed replicas while one
+    replica is SIGTERM-drained and another is KILLED mid-run — the
+    measured "millions of users" curve ROADMAP item 4 asks for. Reports:
+
+    - TTFT / TBT percentiles THROUGH the router (the routing + proxy
+      overhead is in the number);
+    - shed rate (client-visible give-ups; the zero-requests-shed claim:
+      must be 0 — replica sheds are retried or migrated, never passed
+      through);
+    - affinity hit rate (streams landing on their consistent-hash ring
+      owner — the prefix-warmth multiplier);
+    - migration count + latency (stream break -> first resumed byte),
+      and the loss ledger: every completed stream byte-identical to its
+      oracle run, 0 lost / 0 duplicated.
+
+    Mock-backed on purpose (the same content_keyed determinism class the
+    recovery bench and chaos tests pin): the phase measures the FLEET
+    layer — routing, shed handling, migration — not kernel speed, and
+    runs identically on any host."""
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.fleet import FleetRouter
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+    from distributed_llama_multiusers_tpu.serving import StreamRegistry
+    from distributed_llama_multiusers_tpu.server import ApiServer
+    from distributed_llama_multiusers_tpu.tokenizer import TemplateType
+    from distributed_llama_multiusers_tpu.utils.testing import (
+        CharStreamTokenizer,
+        MockAsyncEngine,
+    )
+    import json as _json
+    import urllib.request
+
+    class _FleetTokenizer(CharStreamTokenizer):
+        def decode(self, token):
+            return f"[{token}]"
+
+    n_lanes = 2 if small else 4
+    n_requests = 12 if small else 32
+    max_tokens = 24 if small else 40
+    step_s = 0.004
+
+    def make_replica(rid):
+        engine = MockAsyncEngine(n_lanes=n_lanes, max_chunk=8,
+                                 content_keyed=True, step_s=step_s)
+        sched = ContinuousBatchingScheduler(
+            engine, _FleetTokenizer(64, max_chars=24),
+            speculative=False, prefix_min_tokens=0, multi_step=0,
+        )
+        sched.start()
+        registry = StreamRegistry(grace_s=60.0)
+        api = ApiServer(sched, _FleetTokenizer(64, max_chars=24),
+                        model_name="fleet",
+                        template_type=TemplateType.LLAMA2,
+                        resume=registry, replica_id=rid)
+        httpd = api.serve(host="127.0.0.1", port=0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return {"rid": rid, "sched": sched, "registry": registry,
+                "httpd": httpd,
+                "base": f"127.0.0.1:{httpd.server_address[1]}"}
+
+    replicas = [make_replica(f"r{i}") for i in range(3)]
+    router = FleetRouter(
+        {r["rid"]: r["base"] for r in replicas}, scrape_interval_s=0.1,
+    ).start()
+    rhttpd = router.serve(host="127.0.0.1", port=0)
+    threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+    router.scrape_once()
+    rbase = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+    # three shared-system-prompt families: affinity has something to
+    # steer, and the hit-rate number means prefix-warmth concentration
+    def prompt_for(i):
+        fam = i % 3
+        return ("family %d system prompt " % fam) * 20 + f"user {i}"
+
+    bodies = [
+        {"prompt": prompt_for(i), "max_tokens": max_tokens, "stream": True}
+        for i in range(n_requests)
+    ]
+
+    # oracle pass: each prompt's uninterrupted text, straight off one
+    # replica (content_keyed: the stream is a pure function of prompt
+    # content, identical on every replica — the determinism class)
+    oracle = {}
+    for i, body in enumerate(bodies):
+        req = urllib.request.Request(
+            f"http://{replicas[0]['base']}/v1/completions",
+            data=_json.dumps({**body, "stream": False}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            oracle[i] = _json.loads(resp.read())["generated_text"]
+
+    # the churn: Poisson arrivals, one client thread per stream
+    results = {}
+    lock = threading.Lock()
+
+    def client(i, body, t_submit):
+        req = urllib.request.Request(
+            rbase + "/v1/completions", data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        texts, stamps, err = [], [], None
+        try:
+            with urllib.request.urlopen(req, timeout=240) as resp:
+                for line in resp:
+                    line = line.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    p = _json.loads(line[6:])
+                    if "error" in p:
+                        err = p.get("reason", "error")
+                        continue
+                    ch = p.get("choices", [{}])[0]
+                    if ch.get("finish_reason") is None:
+                        texts.append(ch.get("text", ""))
+                        stamps.append(time.perf_counter())
+        except Exception as e:  # noqa: BLE001 — the ledger records it
+            err = f"{type(e).__name__}"
+        with lock:
+            results[i] = ("".join(texts), stamps, t_submit, err)
+
+    rng = np.random.default_rng(23)
+    intervals = rng.exponential(0.04, n_requests)
+    threads = []
+    t0 = time.perf_counter()
+    drained = killed = False
+    for i, (body, dt) in enumerate(zip(bodies, intervals)):
+        time.sleep(dt)
+        th = threading.Thread(
+            target=client, args=(i, body, time.perf_counter()),
+        )
+        th.start()
+        threads.append(th)
+        if not drained and i >= n_requests // 3:
+            # SIGTERM shape on r1: health flips + sheds immediately, a
+            # SHORT drain window, then force-cancel of the remainder —
+            # exactly what a rolling restart that runs out of patience
+            # does. Streams still on r1 must migrate, not die.
+            drained = True
+            threading.Thread(
+                target=lambda: replicas[1]["sched"].drain(timeout=0.3),
+                daemon=True,
+            ).start()
+        if not killed and i >= (2 * n_requests) // 3:
+            # replica death on r2: listener closed (new connects get
+            # ECONNREFUSED, like a dead process) + abrupt stop with
+            # streams mid-flight
+            killed = True
+            replicas[2]["httpd"].shutdown()
+            replicas[2]["httpd"].server_close()
+            threading.Thread(
+                target=replicas[2]["sched"].stop, daemon=True,
+            ).start()
+    for th in threads:
+        th.join(timeout=300)
+    wall = time.perf_counter() - t0
+
+    # the loss ledger: byte-identity against the oracle per stream
+    lost = dup = failed = completed = 0
+    byte_identical = True
+    ttfts, tbts = [], []
+    for i in range(n_requests):
+        text, stamps, t_submit, err = results.get(
+            i, ("", [], t0, "no_result")
+        )
+        if err is not None:
+            failed += 1
+            continue
+        completed += 1
+        if text != oracle[i]:
+            byte_identical = False
+            # char-level ledger: missing chars = lost, extras = dup
+            if len(text) < len(oracle[i]):
+                lost += len(oracle[i]) - len(text)
+            else:
+                dup += len(text) - len(oracle[i])
+        if stamps:
+            ttfts.append((stamps[0] - t_submit) * 1e3)
+            tbts.extend(
+                (b - a) * 1e3 for a, b in zip(stamps, stamps[1:])
+            )
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(q * len(s)))], 1)
+
+    stats = router.handle_stats()
+    mig_hist = router.registry.get("dllama_router_migration_seconds")
+    mig_p50 = mig_hist.quantile(0.5) if mig_hist.count else None
+    router.close()
+    rhttpd.shutdown()
+    for r in replicas:
+        try:
+            r["httpd"].shutdown()
+            r["registry"].close()
+            r["sched"].stop()
+        except RuntimeError:
+            pass
+    affinity_routes = max(1, stats["fleet_affinity_routes"])
+    return {
+        "serving_fleet_replicas": 3,
+        "serving_fleet_requests": n_requests,
+        "serving_fleet_completed": completed,
+        "serving_fleet_failed": failed,
+        "serving_fleet_wall_s": round(wall, 2),
+        "serving_fleet_ttft_p50_ms": pct(ttfts, 0.50),
+        "serving_fleet_ttft_p95_ms": pct(ttfts, 0.95),
+        "serving_fleet_tbt_p50_ms": pct(tbts, 0.50),
+        "serving_fleet_tbt_p95_ms": pct(tbts, 0.95),
+        # the zero-requests-shed claim: replica sheds are retried or
+        # migrated by the router; only a total fleet outage reaches the
+        # client (must be 0 here — one replica stays healthy)
+        "serving_fleet_shed_rate": round(
+            stats["router_giveups"] / n_requests, 3
+        ),
+        "serving_fleet_replica_shed_retries": stats["router_shed_retries"],
+        "serving_fleet_affinity_hit_rate": round(
+            stats["fleet_affinity_hits"] / affinity_routes, 3
+        ),
+        "serving_fleet_migrations": stats["router_migrations_ok"],
+        "serving_fleet_migrations_failed": stats["router_migrations_failed"],
+        "serving_fleet_migration_p50_ms": (
+            round(mig_p50 * 1e3, 1) if mig_p50 is not None else None
+        ),
+        # the loss ledger across a drain AND a kill (chars, not tokens:
+        # finer — a partial-token text diff still counts)
+        "serving_fleet_lost_chars": lost,
+        "serving_fleet_duplicate_chars": dup,
+        "serving_fleet_byte_identical": byte_identical,
+    }
+
+
 def _pipeline_microbench(n_requests=4, max_tokens=48):
     """Drive the REAL scheduler loop over the mocked async engine
     (utils.testing.MockAsyncEngine — the same stub the pinned tests in
@@ -1736,6 +1983,8 @@ def child_main() -> None:
         result = _phase_serving_faults(config, small)
     elif phase == "serving_recovery":
         result = _phase_serving_recovery(config, small)
+    elif phase == "serving_fleet":
+        result = _phase_serving_fleet(config, small)
     elif phase == "ablations":
         result = _phase_ablations(config, small)
     elif phase == "8b":
@@ -1895,6 +2144,7 @@ def main() -> None:
         ("serving", 420.0), ("serving_churn", 300.0),
         ("serving_prefix", 240.0), ("pod_serving", 300.0),
         ("serving_faults", 240.0), ("serving_recovery", 240.0),
+        ("serving_fleet", 240.0),
         ("8b", 500.0), ("ablations", 420.0), ("longctx", 300.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)

@@ -63,6 +63,11 @@ SCOPE = (
     # and recovery re-admits through submit() — all host-side by
     # contract, never holding a device value
     "serving/journal.py", "serving/recovery.py", "serving/resume.py",
+    # the fleet front-end is pure stdlib BY DESIGN (the router holds no
+    # model, no tokenizer, no device): a transfer spelling appearing in
+    # any of these would mean device state leaked a layer up
+    "fleet/__init__.py", "fleet/balancer.py", "fleet/router.py",
+    "fleet/migrate.py",
 )
 CAST_SCOPE = ("runtime/engine.py",)
 

@@ -15,10 +15,13 @@ from __future__ import annotations
 
 import argparse
 
-from ..quants.codec import FloatType
-
 
 def _float_type(s: str) -> int:
+    # lazy: quants.codec pulls numpy, and this module is also the
+    # dllama-router CLI's surface — the router is stdlib-only by design
+    # and must start on hosts without numpy/jax installed
+    from ..quants.codec import FloatType
+
     m = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40, "q80": FloatType.Q80}
     if s not in m:
         raise argparse.ArgumentTypeError(f"unknown float type {s!r}")
@@ -26,6 +29,10 @@ def _float_type(s: str) -> int:
 
 
 def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
+    # imported here, not at module top: build_router_parser below shares
+    # this module, and the router CLI must import without numpy
+    from ..quants.codec import FloatType
+
     p = argparse.ArgumentParser(prog=prog)
     if not api:
         p.add_argument("mode", choices=["inference", "chat", "worker", "train"],
@@ -197,6 +204,16 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "watermark; re-admission is paced through the "
                         "circuit breaker so recovery cannot stampede a "
                         "freshly restarted engine")
+    # fleet serving (fleet/; docs/SERVING.md "Fleet serving")
+    p.add_argument("--replica-id", default=None,
+                   help="serving: this replica's name in a fleet — "
+                        "stamped as the X-DLlama-Replica header on every "
+                        "response and onto SSE terminal chunks so the "
+                        "dllama-router's traces and the migration path "
+                        "can attribute sheds and streams to their source "
+                        "replica. Default: host:port (the machine "
+                        "hostname when binding all interfaces — a fleet "
+                        "of 0.0.0.0:8080s would all share one id)")
     p.add_argument("--reconnect-grace", type=float, default=0.0,
                    help="serving: seconds a disconnected SSE client may "
                         "reattach (GET /v1/stream/<id> with "
@@ -227,6 +244,48 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "(resumes from the latest step_<N> if present)")
     p.add_argument("--save-every", type=int, default=50,
                    help="train: checkpoint every N steps (and at the end)")
+    return p
+
+
+def build_router_parser(prog: str = "dllama-router") -> argparse.ArgumentParser:
+    """CLI surface for the fleet front-end (fleet/router.py) — model-free
+    by design: the router holds no weights and no tokenizer, only the
+    replica table and the client sockets."""
+    p = argparse.ArgumentParser(prog=prog)
+    p.add_argument("--replicas", nargs="+", required=True,
+                   help="engine replica addresses (host:port ...), each a "
+                        "dllama-api process; replica ids default to the "
+                        "addresses (match each replica's --replica-id)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9980)
+    p.add_argument("--affinity-block-chars", type=int, default=None,
+                   help="prefix-affinity block size in prompt characters "
+                        "(~4 chars/token x the KV pool's 64-token page); "
+                        "the affinity key chains content hashes over the "
+                        "prompt's leading blocks, the router twin of the "
+                        "KV prefix tree's node-key chain. Default: "
+                        "fleet default (256)")
+    p.add_argument("--affinity-blocks", type=int, default=None,
+                   help="how many leading blocks the affinity key covers "
+                        "(a long shared system prompt maps to ONE key "
+                        "regardless of what follows); 0 disables prefix "
+                        "affinity — every request balances by load. "
+                        "Default: fleet default (4)")
+    p.add_argument("--scrape-interval", type=float, default=0.5,
+                   help="seconds between /load scrapes of each replica "
+                        "(queue depth, free lanes, pool pressure, "
+                        "breaker, draining — the routing signals)")
+    p.add_argument("--migration", default="on", choices=["on", "off"],
+                   help="live session migration: cache each stream's "
+                        "exported journal admit record (its migration "
+                        "ticket) and, when the serving replica dies or "
+                        "drains mid-stream, regenerate the session "
+                        "byte-identically on another replica and splice "
+                        "the resumed stream onto the same client socket "
+                        "— zero lost, zero duplicated tokens. Replicas "
+                        "need --reconnect-grace > 0 for the reattach "
+                        "half. 'off': mid-stream failures surface to "
+                        "the client as typed errors instead")
     return p
 
 

@@ -47,7 +47,8 @@ def main(argv=None) -> None:
                      " (no --reconnect-grace: regenerating without "
                      "stream reattach)"))
     server = ApiServer(scheduler, tokenizer, model_name=model_name,
-                       template_type=template_type, resume=registry)
+                       template_type=template_type, resume=registry,
+                       replica_id=getattr(args, "replica_id", None))
     httpd = server.serve(host=args.host, port=args.port)
     log("⭐", f"Server listening on {args.host}:{args.port} ({engine.n_lanes} lanes)")
 

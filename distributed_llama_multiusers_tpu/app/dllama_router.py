@@ -1,0 +1,71 @@
+"""`dllama-router` entry point: the fleet front-end (fleet/router.py).
+
+One model-free process above N `dllama-api` replicas: prefix-affine
+consistent-hash routing (same-system-prompt sessions land on the replica
+holding the warm paged-KV prefix), least-loaded placement from each
+replica's /load scrape, typed shed handling with honored Retry-After,
+and journal-based live migration so drains, rolling restarts and replica
+death shed zero requests (docs/SERVING.md "Fleet serving").
+
+Deliberately import-light: no jax, no model loading — the router starts
+in milliseconds and can front replicas on any backend.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from ..fleet import FleetRouter
+from ..fleet.balancer import DEFAULT_AFFINITY_BLOCKS, DEFAULT_BLOCK_CHARS
+from .args import build_router_parser
+
+
+def log(emoji: str, msg: str) -> None:
+    # runtime_setup.log without the jax import chain
+    print(f"{emoji} {msg}", flush=True)
+
+
+def main(argv=None) -> None:
+    args = build_router_parser().parse_args(argv)
+    block_chars = (
+        DEFAULT_BLOCK_CHARS if args.affinity_block_chars is None
+        else args.affinity_block_chars
+    )
+    blocks = (
+        DEFAULT_AFFINITY_BLOCKS if args.affinity_blocks is None
+        else args.affinity_blocks
+    )
+    router = FleetRouter(
+        list(args.replicas),
+        affinity_block_chars=max(1, block_chars),
+        affinity_blocks=max(0, blocks),
+        scrape_interval_s=args.scrape_interval,
+        migration=args.migration == "on",
+    ).start()
+    router.scrape_once()  # first routing decision sees real load state
+    httpd = router.serve(host=args.host, port=args.port)
+    log("⭐", f"Router listening on {args.host}:{args.port} over "
+              f"{len(args.replicas)} replica(s): {', '.join(args.replicas)}")
+    log("🧭", "prefix affinity "
+              + (f"on ({blocks} x {block_chars} chars)" if blocks > 0
+                 else "off")
+              + f"; migration {args.migration}")
+
+    def _sigterm(*_):
+        log("⭐", "SIGTERM: router stopping (in-flight streams finish)")
+        # dlint: ok[condvar] shutdown() must come from another thread (serve_forever runs on THIS one); nothing joins the helper
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        log("⭐", "Shutting down")
+    finally:
+        httpd.shutdown()
+        router.close()
+
+
+if __name__ == "__main__":
+    main()

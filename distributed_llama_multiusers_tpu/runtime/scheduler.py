@@ -44,6 +44,7 @@ from ..serving import (
     QosQueue,
     RequestJournal,
     StepWatchdog,
+    admit_record,
     budget_expired,
     drain_scheduler,
     queue_expired,
@@ -110,6 +111,15 @@ _req_ids_lock = threading.Lock()
 def _next_request_id() -> int:
     with _req_ids_lock:
         return next(_req_ids)
+
+
+def fresh_request_id() -> int:
+    """A new unique id from the shared counter — public surface for the
+    fleet migration endpoint, which REMAPS an injected session whose
+    original id collides with a live request on this replica (every
+    replica numbers from 1, so same-id-live collisions across a fleet
+    are routine; see server/http.py _admin_migrate)."""
+    return _next_request_id()
 
 
 def ensure_request_id_floor(min_used_id: int) -> None:
@@ -420,6 +430,15 @@ class ContinuousBatchingScheduler:
         # restart, the replay coordinator whose counters /stats merges
         self.journal = journal
         self.recovery = None
+        # fleet migration (serving/journal.admit_record, fleet/migrate.py):
+        # the live-session mirror of each admitted request's journal admit
+        # record — journal-independent, so a replica without --journal-path
+        # can still export a migration ticket. Entries are built whole on
+        # the loop thread and assigned/popped with single-key dict ops
+        # (GIL-atomic); export_session reads whole entries from HTTP
+        # threads. Bounded by n_lanes: records exist only while the
+        # request holds a lane.
+        self._session_records: dict[int, tuple[dict, Request]] = {}
         self._chat_stops = TokenizerChatStops(tokenizer)
         self._prefill_rr = 0  # round-robin cursor over admitting lanes
         # deadline enforcement counters (loop thread writes, /stats reads;
@@ -578,6 +597,24 @@ class ContinuousBatchingScheduler:
             id=entry.request_id,
         )
 
+    def export_session(self, request_id: int) -> dict | None:
+        """Export a live session's migration ticket (fleet/migrate.py,
+        ``GET /admin/session/<id>``): its admit wire record — prompt
+        tokens, sampler params with the RESOLVED seed, QoS class,
+        deadlines (serving/journal.admit_record) — plus a ``watermark``
+        (tokens consumed so far, informational: the migration target
+        re-buffers from 0 and the client's ``Last-Event-ID`` picks the
+        resume point). ``None`` for unknown/finished requests — only an
+        ADMITTED request has a resolved seed to regenerate from; queued
+        ones are re-sent by the router, not migrated."""
+        got = self._session_records.get(int(request_id))
+        if got is None:
+            return None
+        rec, req = got
+        out = dict(rec)
+        out["watermark"] = len(req.generated_tokens)
+        return out
+
     # -- internals ----------------------------------------------------------
 
     def _free_lane_indices(self) -> list[int]:
@@ -693,6 +730,8 @@ class ContinuousBatchingScheduler:
         req.state = RequestState.FAILED
         req.error = error
         req.finish_reason = "error"
+        # failed contents are final: the session can no longer migrate
+        self._session_records.pop(req.id, None)
         self._lanes[lane_idx] = _Lane()
         self._lane_kv[lane_idx] = []
         try:
@@ -892,23 +931,28 @@ class ContinuousBatchingScheduler:
             self.tokenizer.eos_token_ids, stops, self.eos_padding[0], self.eos_padding[1]
         )
         lane.decoder = self.tokenizer.make_stream_decoder()
+        # admit record LAST, with the RESOLVED seed (an unseeded request
+        # just drew OS entropy into lane.seed): everything a deterministic
+        # replay needs, and nothing is recorded for a request that failed
+        # tokenization above (no admit record -> nothing to resurrect or
+        # migrate). ONE kwargs set feeds both consumers — the journal's
+        # on-disk record and the live-session mirror export_session serves
+        # as the fleet migration ticket — so the two cannot drift.
+        admit_kw = dict(
+            request_id=req.id, prompt=req.prompt, tokens=list(tokens),
+            max_tokens=req.max_tokens, temperature=req.temperature,
+            topp=req.topp, seed=int(lane.seed), stop=list(req.stop),
+            add_bos=req.add_bos,
+            add_special_tokens=req.add_special_tokens,
+            user=req.user_id, priority=int(req.priority),
+            queue_timeout_s=req.queue_timeout_s, budget_s=req.budget_s,
+            stream=req.on_delta is not None, kind=req.api_kind,
+        )
+        self._session_records[req.id] = (admit_record(**admit_kw), req)
         if self.journal is not None:
-            # journaled LAST, with the RESOLVED seed (an unseeded request
-            # just drew OS entropy into lane.seed): everything a
-            # deterministic replay needs, and nothing is journaled for a
-            # request that failed tokenization above (no admit record ->
-            # nothing to resurrect). The call only enqueues — the
-            # journal's writer thread does the file I/O off this loop.
-            self.journal.record_admit(
-                request_id=req.id, prompt=req.prompt, tokens=list(tokens),
-                max_tokens=req.max_tokens, temperature=req.temperature,
-                topp=req.topp, seed=int(lane.seed), stop=list(req.stop),
-                add_bos=req.add_bos,
-                add_special_tokens=req.add_special_tokens,
-                user=req.user_id, priority=int(req.priority),
-                queue_timeout_s=req.queue_timeout_s, budget_s=req.budget_s,
-                stream=req.on_delta is not None, kind=req.api_kind,
-            )
+            # the call only enqueues — the journal's writer thread does
+            # the file I/O off this loop
+            self.journal.record_admit(**admit_kw)
 
     def _prefill_step(self) -> bool:
         """Advance ONE admitting lane by one prompt bucket (round-robin).
@@ -1585,6 +1629,10 @@ class ContinuousBatchingScheduler:
     def _finish(self, lane_idx: int, req: Request, reason: str = "stop") -> None:
         req.state = RequestState.DONE
         req.finish_reason = reason
+        # the migration ticket dies with the session: a finished request
+        # has nothing left to move (routers fetch their ticket at stream
+        # start, so a drain/stop force-cancel popping this is fine)
+        self._session_records.pop(req.id, None)
         delta = self._lanes[lane_idx].eos.get_delta()
         if delta:
             req.generated_text += delta

@@ -42,8 +42,14 @@ import json
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..runtime.scheduler import Request
-from ..serving import AdmissionRejected, StreamRelay, jittered_retry_after
+from ..runtime.scheduler import Request, fresh_request_id
+from ..serving import (
+    AdmissionRejected,
+    StreamRelay,
+    attach_recovered_stream,
+    entry_from_admit_record,
+    jittered_retry_after,
+)
 from ..tokenizer import ChatItem, TemplateType, chat_generator_for
 from . import api_types
 
@@ -79,19 +85,26 @@ class ApiServer:
     def __init__(self, scheduler, tokenizer, model_name: str = "dllama",
                  template_type: TemplateType = TemplateType.UNKNOWN,
                  result_timeout_s: float = DEFAULT_RESULT_TIMEOUT_S,
-                 resume=None):
+                 resume=None, replica_id: str | None = None):
         """``resume`` (serving/resume.StreamRegistry, built by dllama-api
         when ``--reconnect-grace`` > 0): streamed requests register their
         delta relay so a disconnected client can reattach within the
         grace window (``GET /v1/stream/<id>`` + ``Last-Event-ID``) —
         including streams recovered from the journal after a crash. None
-        (the default) preserves cancel-on-disconnect exactly."""
+        (the default) preserves cancel-on-disconnect exactly.
+
+        ``replica_id`` (``--replica-id``, default host:port at
+        ``serve()``): this replica's name in a fleet — stamped as the
+        ``X-DLlama-Replica`` header on every response and onto the SSE
+        terminal chunk, so fleet traces and the migration path can
+        attribute every shed and every stream to its source replica."""
         self.scheduler = scheduler
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.chat_template = chat_generator_for(tokenizer, template_type)
         self.result_timeout_s = result_timeout_s
         self.resume = resume
+        self.replica_id = replica_id
         self._httpd: ThreadingHTTPServer | None = None
         self._fallback_tel = None  # see _telemetry()
 
@@ -265,24 +278,30 @@ class ApiServer:
             # error chunk first: reason + Retry-After hint, or a stream
             # client reads the empty "cancelled" terminal as the model's
             # answer and never backs off or retries
-            send_chunk({
+            shed = {
                 "error": str(e), "reason": e.reason,
                 "retry_after_s": round(
                     jittered_retry_after(e.retry_after_s, req.id), 2
                 ),
                 "request_id": req.id,
-            })
+            }
+            if self.replica_id:
+                shed["replica"] = self.replica_id
+            send_chunk(shed)
             req.finish_reason = "cancelled"
         # terminal chunk carries the SAME per-request summary the
         # non-streaming response does (one producer: the scheduler's
-        # telemetry finish hook), so stream clients are not blind
-        send_chunk(
-            chunk_fn(
-                self.model_name, req.id, None, True,
-                req.finish_reason or "stop", summary=req.summary,
-            ),
-            event_id=len(req.generated_tokens),
+        # telemetry finish hook), so stream clients are not blind —
+        # plus the replica id, so fleet traces can attribute the stream
+        # (and a router can name the source on migration) even when the
+        # response headers were consumed by an intermediary
+        term = chunk_fn(
+            self.model_name, req.id, None, True,
+            req.finish_reason or "stop", summary=req.summary,
         )
+        if self.replica_id:
+            term["replica"] = self.replica_id
+        send_chunk(term, event_id=len(req.generated_tokens))
         return True
 
     def handle_models(self) -> dict:
@@ -378,6 +397,42 @@ class ApiServer:
             out.update(tel.tracer.counts())  # window is visible, not silent
         return out
 
+    def handle_load(self) -> dict:
+        """The fleet routing surface (``GET /load``; the same fields ride
+        the ``/health`` body): ONE cheap JSON with everything a router
+        needs per routing decision — queue depth, free lanes, paged-pool
+        pressure, breaker state, draining flag — so a fleet front-end
+        never has to parse full Prometheus text to pick a replica.
+        Always HTTP 200 (it is a machine surface, not a readiness
+        probe; ``/health`` keeps the status-code semantics)."""
+        sched = self.scheduler
+        busy, total = sched.occupancy()
+        breaker = getattr(sched, "breaker", None)
+        depth_fn = getattr(sched.queue, "depth", None)
+        draining = bool(getattr(sched, "draining", False))
+        br_state = breaker.state if breaker is not None else "closed"
+        out = {
+            "status": (
+                "draining" if draining
+                else ("unhealthy" if br_state != "closed" else "ok")
+            ),
+            "replica": self.replica_id,
+            "model": self.model_name,
+            "queue_depth": int(depth_fn()) if callable(depth_fn) else 0,
+            "lanes_free": total - busy,
+            "lanes_total": total,
+            "breaker": br_state,
+            "draining": draining,
+        }
+        pool = getattr(sched.engine, "pool_stats", None)
+        ps = pool() if callable(pool) else {}
+        if ps:  # paged engines only — contiguous ones OMIT the fields
+            # (a literal 0 pages free would read as a full pool)
+            out["pool_pages_free"] = ps.get("pool_pages_free", 0)
+            out["pool_pages_total"] = ps.get("pool_pages_total", 0)
+            out["pool_parked_pages"] = ps.get("pool_parked_pages", 0)
+        return out
+
     def _telemetry(self):
         """The scheduler's telemetry hub (telemetry/), or a lazily built
         standalone one for custom schedulers without it — /metrics then
@@ -428,6 +483,11 @@ class ApiServer:
                      headers: dict | None = None):
                 self.send_response(code)
                 self._cors()
+                if api.replica_id:
+                    # fleet attribution: every response names its source
+                    # replica, so router traces and migration decisions
+                    # can attribute sheds/errors without guessing
+                    self.send_header("X-DLlama-Replica", api.replica_id)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 for k, v in (headers or {}).items():
@@ -450,9 +510,17 @@ class ApiServer:
                     headers={"Retry-After": str(max(1, round(retry)))},
                 )
 
-            def _sse_headers(self):
+            def _sse_headers(self, request_id: int | None = None):
                 self.send_response(200)
                 self._cors()
+                if api.replica_id:
+                    self.send_header("X-DLlama-Replica", api.replica_id)
+                if request_id is not None:
+                    # names the stream BEFORE any delta payload does: a
+                    # fleet router fetches its migration ticket
+                    # (/admin/session/<id>) off this, so a stream that
+                    # dies before its first delta is still migratable
+                    self.send_header("X-DLlama-Request", str(request_id))
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
@@ -483,6 +551,17 @@ class ApiServer:
                     # live or journal-recovered stream by request id,
                     # replaying from the client's Last-Event-ID
                     self._resume_stream()
+                elif self.path == "/load":
+                    # fleet routing surface: one cheap JSON per routing
+                    # decision (queue depth, free lanes, pool pressure,
+                    # breaker, draining) — always 200, the router reads
+                    # the fields, not the status line
+                    self._json(200, api.handle_load())
+                elif self.path.startswith("/admin/session/"):
+                    # fleet migration ticket: a live session's admit wire
+                    # record (resolved seed included) + watermark, for a
+                    # router to hand to another replica's /admin/migrate
+                    self._export_session()
                 elif self.path == "/stats":
                     self._json(200, api.handle_stats())
                 elif self.path == "/metrics":
@@ -502,24 +581,18 @@ class ApiServer:
                     # (serving/breaker.py: repeated engine failures or a
                     # watchdog-detected stall), so a failing replica stops
                     # taking traffic instead of collecting hung clients
+                    # the body is handle_load()'s full machine surface
+                    # (queue depth, free lanes, pool pressure, breaker,
+                    # draining) so a router scraping /health per routing
+                    # decision gets everything in one parse; the status
+                    # CODE keeps the load-balancer readiness semantics
                     breaker = getattr(api.scheduler, "breaker", None)
-                    br_state = (
-                        breaker.state if breaker is not None else "closed"
-                    )
-                    if bool(getattr(api.scheduler, "draining", False)):
+                    load = api.handle_load()
+                    if load["draining"]:
+                        self._json(503, load, headers={"Retry-After": "5"})
+                    elif load["breaker"] != "closed":
                         self._json(
-                            503,
-                            {"status": "draining", "model": api.model_name},
-                            headers={"Retry-After": "5"},
-                        )
-                    elif br_state != "closed":
-                        self._json(
-                            503,
-                            {
-                                "status": "unhealthy",
-                                "breaker": br_state,
-                                "model": api.model_name,
-                            },
+                            503, load,
                             headers={
                                 "Retry-After": str(
                                     max(1, round(breaker.retry_after_s()))
@@ -527,9 +600,108 @@ class ApiServer:
                             },
                         )
                     else:
-                        self._json(200, {"status": "ok", "model": api.model_name})
+                        self._json(200, load)
                 else:
                     self._json(404, {"error": "not found"})
+
+            def _export_session(self):
+                """``GET /admin/session/<request_id>``: export a live
+                session's migration ticket — the admit wire record
+                (prompt tokens + RESOLVED seed + params) plus the
+                consumed-token watermark. 404 for unknown/finished
+                requests and for schedulers without the export surface.
+                The router caches this at stream start so a replica
+                death can still be migrated after the source is gone."""
+                try:
+                    rid = int(self.path.rsplit("/", 1)[1])
+                except ValueError:
+                    self._json(400, {"error": "bad session id"})
+                    return
+                export = getattr(api.scheduler, "export_session", None)
+                rec = export(rid) if callable(export) else None
+                if rec is None:
+                    self._json(404, {
+                        "error": "unknown or finished session "
+                                 "(only admitted, in-flight requests "
+                                 "export a migration ticket)",
+                        "request_id": rid,
+                    })
+                    return
+                self._json(200, rec)
+
+            def _admin_migrate(self, body: dict):
+                """``POST /admin/migrate``: accept a session exported
+                from another replica (the admit wire record
+                ``/admin/session/<id>`` serves) and regenerate it here
+                byte-identically through NORMAL breaker-gated admission —
+                PR 10's deterministic replay as a migration primitive.
+                The client (usually the router) then reattaches via
+                ``GET /v1/stream/<id>`` + ``Last-Event-ID``; the relay
+                re-buffers the whole regenerated stream from base=0 and
+                Last-Event-ID alone picks the resume point (zero lost,
+                zero duplicated tokens). A shed (breaker open, queue
+                full, draining, pool exhausted) answers with the same
+                typed 429/503 + Retry-After shape every admission shed
+                uses, so routers retry elsewhere on the hint."""
+                try:
+                    entry = entry_from_admit_record(body)
+                except ValueError as e:
+                    self._json(400, {"error": f"bad migration record: {e}"})
+                    return
+                if entry.stream and api.resume is None:
+                    # without a resume registry the regenerated stream
+                    # has nowhere to buffer and no reattach route — a
+                    # clear config error, not a retryable shed
+                    self._json(409, {
+                        "error": "stream migration needs "
+                                 "--reconnect-grace > 0 on the target "
+                                 "replica (no resume registry)",
+                    })
+                    return
+                # id-collision remap: every replica numbers requests
+                # from 1, so the injected ORIGINAL id routinely names a
+                # LIVE request here — registering under it would clobber
+                # that request's relay/session record and hand its
+                # reattaching client ANOTHER user's stream. A live
+                # session record (admitted) or registry entry (streamed,
+                # queued ones register at build time) means collision:
+                # re-admit under a fresh local id. The response's
+                # request_id is authoritative either way — the router
+                # reattaches by it, never by the ticket's original id.
+                export = getattr(api.scheduler, "export_session", None)
+                live = (
+                    callable(export)
+                    and export(entry.request_id) is not None
+                ) or (
+                    api.resume is not None
+                    and api.resume.contains(entry.request_id)
+                )
+                if live:
+                    entry.request_id = fresh_request_id()
+                req, registered = attach_recovered_stream(
+                    api.scheduler, entry, api.resume
+                )
+                try:
+                    api.scheduler.submit(req)
+                except AdmissionRejected as e:
+                    if registered:
+                        # nothing will ever resolve the future — drop
+                        # the entry or the registry leaks one per shed
+                        api.resume.discard(req.id)
+                    self._reject(e, key=req.id)
+                    return
+                except Exception as e:  # noqa: BLE001 — a migrate inject
+                    # must answer JSON, never a raw handler stack trace
+                    if registered:
+                        api.resume.discard(req.id)
+                    self._json(500, {"error": str(e), "request_id": req.id})
+                    return
+                self._json(200, {
+                    "request_id": req.id,
+                    "stream_path": f"/v1/stream/{req.id}",
+                    "watermark": entry.watermark,
+                    "replica": api.replica_id,
+                })
 
             def _resume_stream(self):
                 """GET /v1/stream/<request_id> + ``Last-Event-ID``: the
@@ -571,7 +743,7 @@ class ApiServer:
                     if kind == "completion"
                     else api_types.chat_chunk_response
                 )
-                self._sse_headers()
+                self._sse_headers(request_id=req.id)
                 try:
                     api._pump(req, relay, gen,
                               relay.base if after is None else after,
@@ -593,16 +765,21 @@ class ApiServer:
                     ),
                 }
                 route = routes.get(self.path)
-                if route is None:
+                if route is None and self.path != "/admin/migrate":
                     self._json(404, {"error": "not found"})
                     return
-                build_fn, handle_fn = route
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
                 except (ValueError, json.JSONDecodeError) as e:
                     self._json(400, {"error": f"bad request: {e}"})
                     return
+                if self.path == "/admin/migrate":
+                    # fleet migration inject (see _admin_migrate): rides
+                    # the same body parse, then the recovery path
+                    self._admin_migrate(body)
+                    return
+                build_fn, handle_fn = route
                 # request id in EVERY failure payload once a Request exists
                 # (satellite: a streamed failure must correlate with the
                 # server's per-request log lines); None before build_fn
@@ -633,7 +810,7 @@ class ApiServer:
                                 api.resume.discard(req.id)
                             raise
                         try:
-                            self._sse_headers()
+                            self._sse_headers(request_id=req.id)
                         except BaseException:
                             # client vanished between submit and the header
                             # commit: no pump will ever run, so cancel or the
@@ -676,6 +853,18 @@ class ApiServer:
                     self._json(500, err({"error": str(e)}))
 
         httpd = ThreadingHTTPServer((host, port), Handler)
+        if self.replica_id is None:
+            # default fleet identity: where this replica listens (read
+            # off the bound socket, so port=0 ephemeral binds resolve).
+            # A wildcard bind substitutes the machine's hostname — every
+            # replica defaulting to "0.0.0.0:8080" would make the
+            # attribution header identical (useless) across the fleet.
+            id_host = host
+            if id_host in ("", "0.0.0.0", "::"):
+                import socket as _socket
+
+                id_host = _socket.gethostname()
+            self.replica_id = f"{id_host}:{httpd.server_address[1]}"
         self._httpd = httpd
         return httpd
 

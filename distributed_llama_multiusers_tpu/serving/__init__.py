@@ -22,7 +22,14 @@ from .deadlines import (
     queue_timeout_for,
 )
 from .drain import drain_scheduler
-from .journal import JournalEntry, JournalImage, RequestJournal, read_journal
+from .journal import (
+    JournalEntry,
+    JournalImage,
+    RequestJournal,
+    admit_record,
+    entry_from_admit_record,
+    read_journal,
+)
 from .qos import (
     AdmissionRejected,
     Priority,
@@ -30,6 +37,10 @@ from .qos import (
     jittered_retry_after,
     page_cost,
 )
-from .recovery import RecoveryCoordinator, recover_scheduler
+from .recovery import (
+    RecoveryCoordinator,
+    attach_recovered_stream,
+    recover_scheduler,
+)
 from .resume import StreamRegistry, StreamRelay
 from .watchdog import StepWatchdog

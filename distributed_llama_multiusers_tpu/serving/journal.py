@@ -62,6 +62,63 @@ MAX_RECORD_BYTES = 16 << 20
 MAX_PENDING = 65536
 
 
+def admit_record(*, request_id: int, prompt: str, tokens: list[int],
+                 max_tokens: int, temperature: float, topp: float,
+                 seed: int, stop: list[str], add_bos: bool,
+                 add_special_tokens: bool, user: str | None, priority: int,
+                 queue_timeout_s: float | None, budget_s: float | None,
+                 stream: bool, kind: str | None = None) -> dict:
+    """THE admit wire record — one field-mapping site shared by
+    :meth:`RequestJournal.record_admit` (the on-disk journal) and the
+    scheduler's live-session mirror (``export_session``, the fleet
+    migration ticket a router hands to another replica), so the two
+    encodings provably cannot drift. Everything a deterministic replay
+    needs, with the RESOLVED seed."""
+    return {
+        "k": "admit", "id": int(request_id), "prompt": prompt,
+        "tokens": [int(t) for t in tokens],
+        "max_tokens": int(max_tokens), "temp": float(temperature),
+        "topp": float(topp), "seed": int(seed),
+        "stop": list(stop), "add_bos": bool(add_bos),
+        # user None stays null: an anonymous request must come back
+        # from recovery anonymous, not as a QoS fair-share user
+        # literally named "None"
+        "add_special": bool(add_special_tokens),
+        "user": None if user is None else str(user),
+        "prio": int(priority), "queue_timeout_s": queue_timeout_s,
+        "budget_s": budget_s, "stream": bool(stream), "kind": kind,
+    }
+
+
+def entry_from_admit_record(rec: dict) -> "JournalEntry":
+    """Materialize one admit wire record (as :func:`admit_record` /
+    ``record_admit`` encode it) back into a :class:`JournalEntry` —
+    the decode half of the fleet migration ticket: a replica's
+    ``/admin/migrate`` endpoint feeds the result straight into
+    ``scheduler.build_recovered_request``, the same path crash recovery
+    replays through. Runs the SAME fold ``read_journal`` uses
+    (:meth:`JournalImage.apply`), so the two decoders cannot drift; an
+    optional ``watermark`` field rides along (tokens the source replica
+    had delivered — informational: resumption is by ``Last-Event-ID``,
+    never by watermark skip). Raises ``ValueError`` on a malformed
+    record."""
+    if rec.get("k", "admit") != "admit":
+        raise ValueError(f"not an admit record (k={rec.get('k')!r})")
+    image = JournalImage()
+    try:
+        image.apply({**rec, "k": "admit"})
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed admit record: {e}") from e
+    if not image.entries:
+        raise ValueError("admit record carried no request id")
+    entry = next(iter(image.entries.values()))
+    try:
+        entry.watermark = max(0, int(rec.get("watermark", 0) or 0))
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"malformed watermark: {e}") from e
+    return entry
+
+
 @dataclass
 class JournalEntry:
     """One request's journaled state after a sequential replay of the
@@ -293,20 +350,14 @@ class RequestJournal:
             # finish record popped the mark cannot resurrect the entry
             # (a per-request leak plus a spurious post-finish record)
             self._j_progress_mark.setdefault(int(request_id), 0)
-        self._enqueue({
-            "k": "admit", "id": int(request_id), "prompt": prompt,
-            "tokens": [int(t) for t in tokens],
-            "max_tokens": int(max_tokens), "temp": float(temperature),
-            "topp": float(topp), "seed": int(seed),
-            "stop": list(stop), "add_bos": bool(add_bos),
-            # user None stays null: an anonymous request must come back
-            # from recovery anonymous, not as a QoS fair-share user
-            # literally named "None"
-            "add_special": bool(add_special_tokens),
-            "user": None if user is None else str(user),
-            "prio": int(priority), "queue_timeout_s": queue_timeout_s,
-            "budget_s": budget_s, "stream": bool(stream), "kind": kind,
-        })
+        self._enqueue(admit_record(
+            request_id=request_id, prompt=prompt, tokens=tokens,
+            max_tokens=max_tokens, temperature=temperature, topp=topp,
+            seed=seed, stop=stop, add_bos=add_bos,
+            add_special_tokens=add_special_tokens, user=user,
+            priority=priority, queue_timeout_s=queue_timeout_s,
+            budget_s=budget_s, stream=stream, kind=kind,
+        ))
 
     def note_progress(self, request_id: int, tokens_delivered: int) -> None:
         """Advance a request's delivery watermark. Called AFTER a delta

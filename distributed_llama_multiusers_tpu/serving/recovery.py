@@ -50,6 +50,40 @@ from .qos import AdmissionRejected
 DEFAULT_ENTRY_DEADLINE_S = 120.0
 
 
+def attach_recovered_stream(scheduler, entry: JournalEntry, registry=None):
+    """Materialize one journal entry into a Request and — for streamed
+    entries with a resume registry — register its relay, ready for
+    ``scheduler.submit()``. Returns ``(request, registered)``.
+
+    The single-entry body shared by the crash-replay thread below and
+    the fleet migration endpoint (``POST /admin/migrate``,
+    server/http.py): a router hands a live session's exported admit
+    record to another replica, which regenerates it byte-identically
+    through this exact path. The relay registers at ``base=0`` — NOT any
+    journaled/exported watermark: a watermark trails the source's
+    transport writes, not client receipt, so fast-forwarding through it
+    would turn the client's honest ``Last-Event-ID`` into a resume_gap
+    and lose the stranded deltas for good. The whole regenerated stream
+    re-buffers (bounded by max_tokens — the regeneration happens anyway)
+    and ``Last-Event-ID`` alone picks the resume point.
+
+    Callers own the shed path: a ``submit()`` that raises must
+    ``registry.discard(request.id)`` when ``registered`` is True, or the
+    registry leaks one entry per shed."""
+    req = scheduler.build_recovered_request(entry)
+    registered = False
+    if registry is not None and entry.stream:
+        relay = registry.register(req, kind=entry.kind)
+        registered = True
+        # token index = consumed-token count at emit time
+        req.on_delta = (
+            lambda d, r=req, rel=relay: rel.push(
+                len(r.generated_tokens), d
+            )
+        )
+    return req, registered
+
+
 class RecoveryCoordinator:
     """Owns the replay thread and the recovery counters /stats surfaces
     (scheduler.qos_stats merges ``stats()``; telemetry/hub bridges the
@@ -113,27 +147,12 @@ class RecoveryCoordinator:
 
     def _replay_one(self, entry: JournalEntry) -> None:
         scheduler = self.scheduler
-        req = scheduler.build_recovered_request(entry)
-        registered = False
-        if self.registry is not None and entry.stream:
-            # base=0, NOT the journaled watermark: the watermark trails
-            # the server's TRANSPORT writes, and a delta sitting in the
-            # dead process's socket send buffer was written-but-never-
-            # received — fast-forwarding through it would turn the
-            # client's honest Last-Event-ID into a resume_gap and lose
-            # those tokens for good. The relay re-buffers the whole
-            # regenerated stream (bounded by max_tokens; the regeneration
-            # happens anyway for KV/determinism) and the reattaching
-            # client's Last-Event-ID — the only receipt truth there is —
-            # picks the resume point.
-            relay = self.registry.register(req, kind=entry.kind)
-            registered = True
-            # token index = consumed-token count at emit time
-            req.on_delta = (
-                lambda d, r=req, rel=relay: rel.push(
-                    len(r.generated_tokens), d
-                )
-            )
+        # base=0 re-buffer rule and the watermark argument live on
+        # attach_recovered_stream — the body this thread shares with the
+        # fleet migration endpoint
+        req, registered = attach_recovered_stream(
+            scheduler, entry, self.registry
+        )
         deadline = time.monotonic() + self.entry_deadline_s
         while True:
             if self._stop_evt.is_set():

@@ -283,6 +283,12 @@ class StreamRegistry:
         with self._cv:
             self._rg_entries.pop(int(request_id), None)
 
+    def contains(self, request_id: int) -> bool:
+        """Non-mutating existence probe (no timer/generation changes) —
+        the migration endpoint's id-collision check."""
+        with self._lock:
+            return int(request_id) in self._rg_entries
+
     def depth(self) -> int:
         with self._lock:
             return len(self._rg_entries)

@@ -1237,6 +1237,90 @@ def test_crash_durability_files_in_all_scopes(tmp_path):
     assert clean == []
 
 
+# -- fleet front-end (fleet/) -------------------------------------------------
+
+
+def test_host_sync_covers_fleet_files(tmp_path):
+    """ISSUE-12 satellite: the fleet package is pure stdlib BY DESIGN
+    (the router holds no model and no device) — a transfer spelling in
+    any fleet module means device state leaked a layer up, and is a
+    host-sync finding like in runtime/ and serving/."""
+    bad = """
+        import numpy as np
+
+        def pick(keys):
+            return np.asarray(keys)
+    """
+    for rel in ("fleet/balancer.py", "fleet/router.py",
+                "fleet/migrate.py"):
+        findings = run_on(tmp_path / rel.replace("/", "_"), {rel: bad})
+        assert checks_of(findings) == ["host-sync"], rel
+    # the clean shape: pure host hashing/bisect, the real balancer idiom
+    clean = run_on(tmp_path / "ok", {"fleet/balancer.py": """
+        import bisect
+        import zlib
+
+        def prefix_key(data, block):
+            key = 0
+            for b in range(len(data) // block):
+                key = zlib.crc32(data[b * block:(b + 1) * block], key)
+            return key
+
+        def ring_find(ring, point):
+            return bisect.bisect_left(ring, (point, ""))
+    """})
+    assert clean == []
+
+
+def test_real_fleet_balancer_guard_decls_are_collected():
+    """FleetBalancer's replica-table declaration reaches the guarded-by
+    checker (the rot-guard pattern: the declaration syntax must not
+    silently rot out of collection)."""
+    import ast
+
+    from distributed_llama_multiusers_tpu.analysis.core import Project, SourceFile
+    from distributed_llama_multiusers_tpu.analysis.lock_check import GuardedByChecker
+
+    project = Project()
+    checker = GuardedByChecker()
+    p = PACKAGE_ROOT / "fleet/balancer.py"
+    sf = SourceFile(
+        path=p, display="fleet/balancer.py", text=p.read_text(),
+        tree=ast.parse(p.read_text()),
+    )
+    checker.collect(sf, project)
+    assert "_fb_replicas" in project.guarded
+    assert "_fb_ring" in project.guarded
+    assert "_fb_affinity_hits" in project.guarded
+    assert project.guarded["_fb_replicas"][0] == frozenset({"_lock"})
+
+
+def test_guarded_by_flags_unlocked_fleet_table(tmp_path):
+    """Known-bad: a replica-table read outside the balancer lock (picks
+    race the scrape thread through exactly this state) is a finding;
+    the locked shape is clean."""
+    findings = run_on(tmp_path, {"fleet/balancer.py": """
+        import threading
+
+        class FleetBalancer:
+            _dlint_guarded_by = {("_lock",): ("_fb_replicas", "_fb_ring")}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._fb_replicas = {}
+                self._fb_ring = []
+
+            def bad_pick(self, rid):
+                return self._fb_replicas.get(rid)
+
+            def good_pick(self, rid):
+                with self._lock:
+                    return self._fb_replicas.get(rid)
+    """})
+    assert checks_of(findings) == ["guarded-by"]
+    assert "_fb_replicas" in findings[0].message
+
+
 # -- lock-blocking ------------------------------------------------------------
 
 
