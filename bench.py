@@ -42,6 +42,13 @@ single phase can eat the budget:
                (must be 0 — sheds are retried or migrated), affinity
                hit rate, migration count + latency, and the loss
                ledger (byte-identical, 0 lost / 0 duplicated)
+  serving_structured — the structured-output gate: Poisson churn with a
+               JSON-schema workload mixed into plain lanes; reports
+               valid-JSON rate (must be 1.0), schema-compile ms
+               (cold/cached), masked-steps per dispatch, pipeline
+               flushes (must be 0), and a constrained stream killed
+               mid-flight replaying byte-identically through journal
+               recovery
   ablations  — packed Q40 via XLA dequant, dense bf16 (what the kernel buys)
   8b         — the BASELINE north star: Llama-3.1-8B Q40 decode tok/s vs
                200 tok/s/chip (BASELINE.md), now on by default
@@ -1473,6 +1480,201 @@ def _phase_serving_recovery(config, small):
     }
 
 
+def _phase_serving_structured(config, small):
+    """The structured-output gate (ISSUE 13): Poisson churn with a
+    JSON-schema workload MIXED into plain lanes against the real
+    scheduler — constrained (json_object + json_schema, greedy and
+    sampled) and unconstrained requests share the fused pipelined chain.
+    Reports:
+
+    - ``structured_valid_json_rate`` — fraction of constrained
+      completions that parse as (schema-valid) JSON: MUST be 1.0, the
+      on-device mask is the whole point;
+    - ``structured_schema_compile_ms`` — cold automaton compile cost
+      (token closure over the vocab) and the cached re-admission cost;
+    - ``structured_masked_steps_per_dispatch`` — how often the mask
+      actually bit, over all pipeline dispatches;
+    - ``structured_pipeline_flushes`` — MUST be 0: constrained lanes
+      ride the zero-flush chain like everyone else;
+    - ``structured_replay_identical`` — a constrained stream killed
+      mid-flight replays byte-identically through journal recovery
+      (the crash-durability contract extended to grammars).
+
+    Mock-backed on purpose (content_keyed determinism class): the phase
+    measures the GRAMMAR layer — compile cost, mask cadence, validity,
+    replay — not kernel speed, and runs identically on any host."""
+    import tempfile
+
+    import numpy as np
+
+    from distributed_llama_multiusers_tpu.grammar.automaton import (
+        _cache as _gram_cache,
+    )
+    from distributed_llama_multiusers_tpu.runtime.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+    from distributed_llama_multiusers_tpu.serving import (
+        RequestJournal,
+        read_journal,
+    )
+    from distributed_llama_multiusers_tpu.utils.testing import (
+        ByteJsonTokenizer,
+        MockAsyncEngine,
+    )
+
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "score": {"type": "integer"},
+            "tags": {"type": "array", "items": {"type": "string"}},
+            "verdict": {"enum": ["pass", "fail", None]},
+        },
+        "required": ["name", "verdict"],
+    }
+    schema_rf = {"type": "json_schema", "json_schema": {"schema": schema}}
+    n_requests = 12 if small else 48
+    n_lanes = 4 if small else 8
+
+    def build():
+        tok = ByteJsonTokenizer()
+        eng = MockAsyncEngine(
+            n_lanes=n_lanes, vocab=258, speculative=True,
+            content_keyed=True,
+        )
+        eng.grammar_init(tok.token_table(), tok.eos_token_ids)
+        return tok, eng
+
+    # cold vs cached schema compile (the per-admission cost ladder)
+    tok0, eng0 = build()
+    _gram_cache.clear()
+    t0 = time.perf_counter()
+    h0 = eng0.grammar_attach(schema_rf)
+    compile_cold_ms = (time.perf_counter() - t0) * 1e3
+    eng0.grammar_detach(h0.key)
+    t0 = time.perf_counter()
+    eng0.grammar_attach(schema_rf)  # cache hit + parked-slab re-attach
+    compile_cached_ms = (time.perf_counter() - t0) * 1e3
+
+    tok, engine = build()
+    sched = ContinuousBatchingScheduler(engine, tok, prefix_min_tokens=0)
+    rng = np.random.default_rng(7)
+    reqs = [
+        Request(
+            prompt=f"structured churn {i}",
+            max_tokens=800,
+            temperature=0.0 if i % 2 == 0 else 0.7,
+            seed=300 + i,
+            response_format=[
+                schema_rf, None, {"type": "json_object"}, None
+            ][i % 4],
+        )
+        for i in range(n_requests)
+    ]
+    sched.start()
+    t0 = time.perf_counter()
+    try:
+        for r, dt in zip(reqs, rng.exponential(0.01, n_requests)):
+            time.sleep(dt)
+            sched.submit(r)
+        for r in reqs:
+            r.future.result(timeout=600)
+    finally:
+        sched.stop()
+    wall = time.perf_counter() - t0
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+
+    constrained = [r for r in reqs if r.response_format is not None]
+    valid = 0
+    for r in constrained:
+        try:
+            obj = json.loads(r.generated_text)
+        except ValueError:
+            continue
+        if r.response_format is schema_rf:
+            if (
+                isinstance(obj, dict)
+                and {"name", "verdict"} <= set(obj)
+                and set(obj) <= {"name", "score", "tags", "verdict"}
+                and obj["verdict"] in ("pass", "fail", None)
+            ):
+                valid += 1
+        elif isinstance(obj, dict):
+            valid += 1
+    stats = engine.stats.snapshot()
+
+    # kill-and-replay: journal a constrained stream, cancel it mid-
+    # flight (the crash stand-in), regenerate from the journaled
+    # (prompt, seed, schema) on a FRESH scheduler — byte-identical
+    tokr, engr = build()
+    ref_sched = ContinuousBatchingScheduler(engr, tokr, prefix_min_tokens=0)
+    ref_sched.start()
+    try:
+        ref = ref_sched.submit(Request(
+            prompt="replay probe", max_tokens=800, seed=99,
+            response_format=schema_rf,
+        ))
+        ref_text = ref.future.result(timeout=120)
+    finally:
+        ref_sched.stop()
+    jpath = os.path.join(
+        tempfile.gettempdir(), "dllama_structured_bench_journal.bin"
+    )
+    if os.path.exists(jpath):
+        os.unlink(jpath)
+    journal = RequestJournal(jpath, progress_every=1, fsync=False)
+    tokc, engc = build()
+    crash_sched = ContinuousBatchingScheduler(
+        engc, tokc, prefix_min_tokens=0, journal=journal
+    )
+    crash_sched.start()
+    try:
+        crash = crash_sched.submit(Request(
+            prompt="replay probe", max_tokens=800, seed=99,
+            response_format=schema_rf,
+        ))
+        while not crash.generated_tokens:
+            time.sleep(0.001)
+        journal.flush()
+        img = read_journal(jpath)
+    finally:
+        crash_sched.stop()
+        journal.close()
+    tok2, eng2 = build()
+    sched2 = ContinuousBatchingScheduler(eng2, tok2, prefix_min_tokens=0)
+    sched2.start()
+    try:
+        re_req = sched2.build_recovered_request(img.entries[crash.id])
+        sched2.submit(re_req)
+        replayed = re_req.future.result(timeout=120)
+    finally:
+        sched2.stop()
+
+    return {
+        "phase": "serving_structured",
+        "structured_requests": n_requests,
+        "structured_constrained": len(constrained),
+        "structured_valid_json_rate": round(valid / len(constrained), 4),
+        "structured_tok_s": round(
+            sum(len(r.generated_tokens) for r in reqs) / wall, 2
+        ),
+        "structured_schema_compile_ms": round(compile_cold_ms, 2),
+        "structured_schema_compile_cached_ms": round(compile_cached_ms, 3),
+        "structured_masked_steps_per_dispatch": round(
+            stats["grammar_masked_steps"]
+            / max(1, stats["pipeline_dispatches"]), 3
+        ),
+        "structured_grammar_lanes": stats["grammar_lanes"],
+        "structured_pipeline_flushes": stats["pipeline_flushes"],
+        "structured_fused_steps": stats["fused_steps"],
+        "structured_spec_pipelined_steps": stats["spec_pipelined_steps"],
+        "structured_replay_identical": bool(
+            replayed == ref_text and json.loads(replayed)
+        ),
+    }
+
+
 def _phase_serving_fleet(config, small):
     """The fleet gate (ISSUE 12): Poisson SSE traffic through the
     ``dllama-router`` at THREE MockAsyncEngine-backed replicas while one
@@ -1985,6 +2187,8 @@ def child_main() -> None:
         result = _phase_serving_recovery(config, small)
     elif phase == "serving_fleet":
         result = _phase_serving_fleet(config, small)
+    elif phase == "serving_structured":
+        result = _phase_serving_structured(config, small)
     elif phase == "ablations":
         result = _phase_ablations(config, small)
     elif phase == "8b":
@@ -2144,7 +2348,7 @@ def main() -> None:
         ("serving", 420.0), ("serving_churn", 300.0),
         ("serving_prefix", 240.0), ("pod_serving", 300.0),
         ("serving_faults", 240.0), ("serving_recovery", 240.0),
-        ("serving_fleet", 240.0),
+        ("serving_fleet", 240.0), ("serving_structured", 240.0),
         ("8b", 500.0), ("ablations", 420.0), ("longctx", 300.0),
     ):
         budget = min(cap, deadline - time.monotonic() - 10)

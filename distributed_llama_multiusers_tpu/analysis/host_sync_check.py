@@ -68,6 +68,12 @@ SCOPE = (
     # any of these would mean device state leaked a layer up
     "fleet/__init__.py", "fleet/balancer.py", "fleet/router.py",
     "fleet/migrate.py",
+    # grammar-constrained decoding rides the admission + dispatch paths
+    # (scheduler _start_request -> engine.grammar_attach; per-dispatch
+    # mask-state vectors): the compiler and slab are pure-host numpy BY
+    # CONTRACT — a device transfer spelling here would serialize every
+    # constrained dispatch on the automaton tables
+    "grammar/__init__.py", "grammar/automaton.py", "grammar/slab.py",
 )
 CAST_SCOPE = ("runtime/engine.py",)
 

@@ -126,6 +126,24 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "sessions rebuild deterministically from the "
                         "request journal on next activity); 0 disables "
                         "parking; default: pool default (64)")
+    # structured output (grammar/; docs/SERVING.md "Structured output")
+    p.add_argument("--grammar", default="on", choices=["on", "off"],
+                   help="serving: grammar-constrained decoding — requests "
+                        "with response_format {'type':'json_object'} or "
+                        "{'type':'json_schema',...} compile into a "
+                        "token-level automaton enforced INSIDE the "
+                        "compiled step families (masked exact top-p + "
+                        "on-device state carry), so constrained and "
+                        "unconstrained lanes coexist with zero pipeline "
+                        "flushes. 'off' (escape hatch) makes such "
+                        "requests fail with a typed 400")
+    p.add_argument("--grammar-slab-states", type=int, default=None,
+                   help="structured output: device slab capacity in "
+                        "automaton states shared by all live schemas "
+                        "(fixed at startup so schema churn can never "
+                        "recompile XLA programs; admissions beyond it "
+                        "shed retryably). Default: grammar default "
+                        "(1024)")
     # serving QoS (serving/ package): bounded admission + deadlines
     p.add_argument("--max-queue", type=int, default=256,
                    help="serving: max requests waiting for a lane before "

@@ -216,6 +216,9 @@ def load_stack(args, n_lanes: int | None = None):
         kv_max_parked=(DEFAULT_MAX_PARKED
                        if getattr(args, "kv_max_parked", None) is None
                        else args.kv_max_parked),
+        # grammar slab capacity (structured output): every process must
+        # agree — the slab arrays are compiled-program operands
+        grammar_slab_states=getattr(args, "grammar_slab_states", None),
     )
     if engine.kvpool is not None:
         log(
@@ -226,6 +229,19 @@ def load_stack(args, n_lanes: int | None = None):
             f"max parked {engine.kvpool.max_parked} "
             "(--paged-kv off restores contiguous planes)",
         )
+    # structured output (grammar/; docs/SERVING.md "Structured output"):
+    # register the tokenizer's piece table so response_format requests
+    # compile token-level automata — on EVERY process (workers replay
+    # OP_GRAMMAR attaches against their own identical table). --grammar
+    # off is the escape hatch: requests carrying response_format then 400.
+    if getattr(args, "grammar", "on") != "off":
+        engine.grammar_init(
+            [tokenizer.vocab[i] if i < tokenizer.bos_id else None
+             for i in range(tokenizer.vocab_size)],
+            tokenizer.eos_token_ids,
+        )
+        log("🧩", "Structured output: json_object / json_schema enabled "
+                  "(--grammar off disables)")
     if n_proc > 1:
         from ..parallel.multihost import ControlPlane, RootControlEngine
 

@@ -1,0 +1,38 @@
+"""Grammar-constrained decoding: on-device structured output.
+
+A request's ``response_format`` — ``{"type": "json_object"}`` or
+``{"type": "json_schema", "json_schema": {...}}`` — compiles into a
+token-level DFA over the real tokenizer vocab (automaton.py: a byte-level
+JSON character machine, walked by every vocab piece), whose per-state
+legal-token sets become a packed device mask table and whose transitions
+become a compact sparse edge table (slab.py). The engine gathers the
+current state's mask inside every compiled step family, applies ``-inf``
+before the existing exact top-p sort, and computes the next state ON
+DEVICE so the automaton state rides the pipelined carry exactly like the
+position carry — constrained lanes coexist with unconstrained ones at
+``pipeline_flushes == 0``.
+
+Host mirror (``GrammarAutomaton.next_state`` / ``filter_prefix``) serves
+draft pre-filtering, deterministic journal replay, and fleet migration;
+the device tables are the enforcement path.
+"""
+
+from .automaton import (
+    GrammarAutomaton,
+    GrammarError,
+    canonical_key,
+    compile_automaton,
+    validate_response_format,
+)
+from .slab import GrammarSlab, GrammarSlabFull, SlabHandle
+
+__all__ = [
+    "GrammarAutomaton",
+    "GrammarError",
+    "GrammarSlab",
+    "GrammarSlabFull",
+    "SlabHandle",
+    "canonical_key",
+    "compile_automaton",
+    "validate_response_format",
+]

@@ -60,7 +60,14 @@ PACKET_MAGIC = 0x444C4C41  # "DLLA"
 # page tables silently stale (wrong gathers, not a deadlock). The bump
 # turns that silent divergence into a classified ReplayError on the first
 # packet.
-PROTOCOL_VERSION = 3
+# v4: grammar-constrained decoding — SLOTS grew 9 -> 10 (every decode-
+# family op carries the per-lane grammar-state vector; fused prefill
+# headers grew to 7 words for the admitting lane's automaton start
+# state) and OP_GRAMMAR landed (schema broadcast at admission, compiled
+# locally by every process against its own tokenizer table). The packet
+# size changed, so a v3 peer cannot frame a v4 broadcast — the version
+# word classifies it.
+PROTOCOL_VERSION = 4
 
 OP_STOP = 0
 OP_PREFILL = 1
@@ -95,6 +102,16 @@ OP_KV_TABLE = 12  # paged KV (runtime/kvpool.py): one lane's page-table row
 # the copies and the new table row via engine.apply_paged_admit, keeping
 # the replicated table leaf byte-identical on every process. lane == -1
 # means "unmap every lane" (containment reset, engine.paged_unmap_all).
+OP_GRAMMAR = 13  # grammar-constrained decoding (grammar/): broadcast a
+# response_format's canonical JSON at admission so every process compiles
+# the SAME automaton against its own (identical) tokenizer table and
+# installs it at the SAME slab base — deterministic, so the tables never
+# ship over the wire. `lane` carries flags (bit 0: final fragment of the
+# schema bytes, bit 1: detach — payload is the schema KEY, not JSON),
+# `n` the fragment byte length, `start_pos` the fragment index; workers
+# accumulate fragments until the final one, then attach/detach. The
+# root compiles and validates BEFORE the first packet (the pod-deadlock
+# rule: a schema that cannot compile dies with zero packets out).
 
 
 class ReplayError(RuntimeError):
@@ -180,7 +197,7 @@ class ControlPlane:
     """
 
     HEADER = 6  # [magic, version, op, lane, n, start_pos]
-    SLOTS = 9
+    SLOTS = 10
 
     def __init__(self, n_lanes: int, chunk: int = 1024):
         from ..runtime.spec import SPEC_DRAFT
@@ -226,22 +243,24 @@ class ControlPlane:
     def send_prefill(
         self, lane: int, tokens, start_pos: int,
         temp: float = 0.0, topp: float | None = None, seed: int = 0,
+        g_state: int = 0,
     ) -> None:
         if topp is None:  # one default for every sampling surface
             from ..runtime.engine import DEFAULT_TOPP as topp
         tbits = np.asarray([temp], np.float32).view(np.int32)
         pbits = np.asarray([topp], np.float32).view(np.int32)
         sbits = np.asarray([seed & 0xFFFFFFFF], np.uint32).view(np.int32)
+        gbits = np.asarray([g_state], np.int32)
         for off in range(0, len(tokens), self.chunk):
             part = tokens[off : off + self.chunk]
             self._send(
                 OP_PREFILL, lane, len(part), start_pos + off,
-                part, tbits, pbits, sbits,
+                part, tbits, pbits, sbits, gbits,
             )
 
     def send_decode(
         self, tokens, positions, temps=None, topps=None, seeds=None,
-        want_logits: bool = True,
+        want_logits: bool = True, g_states=None,
     ) -> None:
         n = len(tokens)
         as_bits = lambda f: (
@@ -251,35 +270,52 @@ class ControlPlane:
             OP_DECODE, 1 if want_logits else 0, n, 0,
             tokens, positions, as_bits(temps), as_bits(topps),
             None if seeds is None else np.asarray(seeds, np.uint32).view(np.int32),
+            None if g_states is None else np.asarray(g_states, np.int32),
         )
 
     def send_decode_pipelined(
-        self, tokens, positions, temps, topps, seeds, depth: int
+        self, tokens, positions, temps, topps, seeds, depth: int,
+        g_states=None,
     ) -> None:
         n = len(positions)
         # feed flag rides `lane` (tokens present = chain reseed), ring
-        # depth rides `start_pos` — workers mirror the root's bounded lag
+        # depth rides `start_pos` — workers mirror the root's bounded lag;
+        # grammar states ride slot 5 (-1 = the worker's own device carry,
+        # the same select the root's dispatch applies)
         self._send(
             OP_DECODE_PIPELINED, 0 if tokens is None else 1, n, depth,
             tokens, positions,
             np.asarray(temps, np.float32).view(np.int32),
             np.asarray(topps, np.float32).view(np.int32),
             np.asarray(seeds, np.uint32).view(np.int32),
+            None if g_states is None else np.asarray(g_states, np.int32),
         )
 
-    def send_decode_prefill_fused(
-        self, tokens, positions, temps, topps, seeds, depth: int,
-        p_lane: int, chunk, p_start: int, p_temp: float, p_topp: float,
-        p_seed: int,
-    ) -> None:
-        n = len(positions)
-        # DECODE_PIPELINED header layout (feed flag in `lane`, ring depth
-        # in `start_pos`); the chunk rides slot 5 and its header slot 6
-        phdr = np.zeros(6, np.int32)
+    @staticmethod
+    def _prefill_header(p_lane, p_start, chunk, p_temp, p_topp, p_seed,
+                        p_g) -> np.ndarray:
+        """The 7-word fused-prefill header (v4: word 6 is the admitting
+        lane's grammar start state) — ONE encoder for both fused ops."""
+        phdr = np.zeros(7, np.int32)
         phdr[0:3] = (p_lane, p_start, len(chunk))
         phdr[3] = np.asarray([p_temp], np.float32).view(np.int32)[0]
         phdr[4] = np.asarray([p_topp], np.float32).view(np.int32)[0]
         phdr[5] = np.asarray([p_seed & 0xFFFFFFFF], np.uint32).view(np.int32)[0]
+        phdr[6] = p_g
+        return phdr
+
+    def send_decode_prefill_fused(
+        self, tokens, positions, temps, topps, seeds, depth: int,
+        p_lane: int, chunk, p_start: int, p_temp: float, p_topp: float,
+        p_seed: int, g_states=None, p_g: int = 0,
+    ) -> None:
+        n = len(positions)
+        # DECODE_PIPELINED header layout (feed flag in `lane`, ring depth
+        # in `start_pos`); the chunk rides slot 5, its header slot 6,
+        # the grammar-state vector slot 7
+        phdr = self._prefill_header(
+            p_lane, p_start, chunk, p_temp, p_topp, p_seed, p_g
+        )
         self._send(
             OP_DECODE_PREFILL_FUSED, 0 if tokens is None else 1, n, depth,
             tokens, positions,
@@ -288,16 +324,18 @@ class ControlPlane:
             np.asarray(seeds, np.uint32).view(np.int32),
             np.asarray(chunk, np.int32),
             phdr,
+            None if g_states is None else np.asarray(g_states, np.int32),
         )
 
     def send_decode_spec_pipelined(
         self, tokens, positions, temps, topps, seeds, depth: int,
-        drafts, draft_len,
+        drafts, draft_len, g_states=None,
     ) -> None:
         n = len(positions)
         flat = self._check_spec_payload(np.asarray(drafts, np.int32).reshape(-1))
         # DECODE_PIPELINED header layout (feed flag in `lane`, ring depth
-        # in `start_pos`); drafts + lengths ride slots 5/6
+        # in `start_pos`); drafts + lengths ride slots 5/6, grammar
+        # states slot 7
         self._send(
             OP_DECODE_SPEC_PIPELINED, 0 if tokens is None else 1, n, depth,
             tokens, positions,
@@ -306,20 +344,20 @@ class ControlPlane:
             np.asarray(seeds, np.uint32).view(np.int32),
             flat,
             np.asarray(draft_len, np.int32),
+            None if g_states is None else np.asarray(g_states, np.int32),
         )
 
     def send_decode_spec_prefill_fused(
         self, tokens, positions, temps, topps, seeds, depth: int,
         drafts, draft_len, p_lane: int, chunk, p_start: int,
-        p_temp: float, p_topp: float, p_seed: int,
+        p_temp: float, p_topp: float, p_seed: int, g_states=None,
+        p_g: int = 0,
     ) -> None:
         n = len(positions)
         flat = self._check_spec_payload(np.asarray(drafts, np.int32).reshape(-1))
-        phdr = np.zeros(6, np.int32)
-        phdr[0:3] = (p_lane, p_start, len(chunk))
-        phdr[3] = np.asarray([p_temp], np.float32).view(np.int32)[0]
-        phdr[4] = np.asarray([p_topp], np.float32).view(np.int32)[0]
-        phdr[5] = np.asarray([p_seed & 0xFFFFFFFF], np.uint32).view(np.int32)[0]
+        phdr = self._prefill_header(
+            p_lane, p_start, chunk, p_temp, p_topp, p_seed, p_g
+        )
         self._send(
             OP_DECODE_SPEC_PREFILL_FUSED, 0 if tokens is None else 1, n,
             depth,
@@ -331,10 +369,12 @@ class ControlPlane:
             np.asarray(draft_len, np.int32),
             np.asarray(chunk, np.int32),
             phdr,
+            None if g_states is None else np.asarray(g_states, np.int32),
         )
 
     def send_decode_spec(
-        self, tokens, drafts, draft_len, positions, temps, topps, seeds
+        self, tokens, drafts, draft_len, positions, temps, topps, seeds,
+        g_states=None,
     ) -> None:
         n = len(tokens)
         flat = self._check_spec_payload(np.asarray(drafts, np.int32).reshape(-1))
@@ -346,10 +386,12 @@ class ControlPlane:
             np.asarray(seeds, np.uint32).view(np.int32),
             flat,
             np.asarray(draft_len, np.int32),
+            None if g_states is None else np.asarray(g_states, np.int32),
         )
 
     def send_decode_multi(
-        self, tokens, positions, temps, topps, seeds, h: int
+        self, tokens, positions, temps, topps, seeds, h: int,
+        g_states=None,
     ) -> None:
         n = len(tokens)
         # the horizon rides the start_pos header field
@@ -359,7 +401,30 @@ class ControlPlane:
             np.asarray(temps, np.float32).view(np.int32),
             np.asarray(topps, np.float32).view(np.int32),
             np.asarray(seeds, np.uint32).view(np.int32),
+            None if g_states is None else np.asarray(g_states, np.int32),
         )
+
+    def send_grammar(self, blob: bytes, detach: bool = False) -> None:
+        """Broadcast a grammar attach (canonical response_format JSON) or
+        detach (the schema key string) — chunked when the blob outgrows
+        one packet slot; workers accumulate fragments and act on the
+        final one. Every process compiles locally, so the tables never
+        ship over the wire (the broadcast is bytes-of-schema, not
+        megabytes of masks)."""
+        frag_bytes = self.chunk * 4  # int32 words carry 4 schema bytes each
+        frags = [
+            blob[off : off + frag_bytes]
+            for off in range(0, max(1, len(blob)), frag_bytes)
+        ]
+        for idx, frag in enumerate(frags):
+            flags = (1 if idx == len(frags) - 1 else 0) | (
+                2 if detach else 0
+            )
+            pad = (-len(frag)) % 4
+            words = np.frombuffer(frag + b"\0" * pad, np.uint8).view(
+                np.int32
+            )
+            self._send(OP_GRAMMAR, flags, len(frag), idx, words)
 
     def send_pipeline_flush(self) -> None:
         self._send(OP_PIPELINE_FLUSH, 0, 0, 0)
@@ -435,9 +500,35 @@ class RootControlEngine:
     def __getattr__(self, name):  # stats, config, lane_logits, ...
         return getattr(self._engine, name)
 
+    def grammar_attach(self, rf: dict):
+        """Grammar attach on a pod: compile + install ROOT-side FIRST
+        (a schema that cannot compile or fit must die with zero packets
+        out — the pod-deadlock rule), then broadcast the canonical JSON
+        so every worker compiles the identical automaton against its own
+        tokenizer table and lands it at the same slab base (the op
+        stream is ordered, so the deterministic allocators agree)."""
+        import json as _json
+
+        from ..grammar.automaton import validate_response_format
+
+        canon = validate_response_format(rf)
+        handle = self._engine.grammar_attach(rf)
+        # ORDER-PRESERVING serialization (no sort_keys): property
+        # declaration order is semantic (keys emit in that order) — a
+        # sorted broadcast would have workers compile a DIFFERENT
+        # automaton at the same slab base, the silent-desync class the
+        # protocol version exists to prevent
+        self._plane.send_grammar(_json.dumps(canon).encode())
+        return handle
+
+    def grammar_detach(self, key: str) -> None:
+        self._plane.send_grammar(str(key).encode(), detach=True)
+        self._engine.grammar_detach(key)
+
     def prefill_chunk(
         self, lane: int, chunk, start_pos: int,
         temp: float = 0.0, topp: float | None = None, seed: int = 0,
+        g_state: int = 0,
     ):
         if topp is None:  # byte-identical default on packet AND root call
             from ..runtime.engine import DEFAULT_TOPP as topp
@@ -454,9 +545,11 @@ class RootControlEngine:
                 f"{self._engine.max_chunk()}); size ControlPlane(chunk=...) "
                 f">= engine.max_chunk()"
             )
-        self._plane.send_prefill(lane, list(chunk), start_pos, temp, topp, seed)
+        self._plane.send_prefill(lane, list(chunk), start_pos, temp, topp,
+                                 seed, g_state=g_state)
         return self._engine.prefill_chunk(
-            lane, list(chunk), start_pos, temp=temp, topp=topp, seed=seed
+            lane, list(chunk), start_pos, temp=temp, topp=topp, seed=seed,
+            g_state=g_state
         )
 
     def prefill(
@@ -499,18 +592,21 @@ class RootControlEngine:
         )
 
     def decode(self, tokens, positions, temps=None, topps=None, seeds=None,
-               want_logits: bool = True):
+               want_logits: bool = True, g_states=None):
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode(
             np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
             temps, topps, seeds, want_logits=want_logits,
+            g_states=g_states,
         )
         return self._engine.decode(
-            tokens, positions, temps, topps, seeds, want_logits=want_logits
+            tokens, positions, temps, topps, seeds, want_logits=want_logits,
+            g_states=g_states,
         )
 
     def decode_pipelined(
-        self, positions, temps=None, topps=None, seeds=None, tokens=None
+        self, positions, temps=None, topps=None, seeds=None, tokens=None,
+        g_states=None,
     ):
         """Pipelined dispatch on a pod: the packet goes out first, then the
         root enqueues its own half of the async chain. Consume/flush are
@@ -520,15 +616,21 @@ class RootControlEngine:
         # ring-full/missing-carry/bad-reseed-position must raise BEFORE the
         # packet goes out: a broadcast with no matching root-side compute
         # desyncs the pod
-        self._engine.check_pipelined_dispatch(tokens is not None, positions)
+        self._engine.check_pipelined_dispatch(tokens is not None, positions,
+                                              g_states)
+        # materialize the default grammar vector NOW: packet and root-side
+        # compute must carry byte-identical values (the sampling rule)
+        g_states = self._engine._g_vec(g_states, tokens is not None)
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_pipelined(
             None if tokens is None else np.asarray(tokens, np.int32),
             np.asarray(positions, np.int32), temps, topps, seeds,
             depth=getattr(self._engine, "pipeline_depth", 2),
+            g_states=g_states,
         )
         return self._engine.decode_pipelined(
-            positions, temps, topps, seeds, tokens=tokens
+            positions, temps, topps, seeds, tokens=tokens,
+            g_states=g_states,
         )
 
     def _check_fused_chunk(self, chunk, p_topp):
@@ -551,6 +653,7 @@ class RootControlEngine:
         self, positions, temps=None, topps=None, seeds=None,
         p_lane: int = 0, chunk=None, p_start: int = 0, p_temp: float = 0.0,
         p_topp: float | None = None, p_seed: int = 0, tokens=None,
+        g_states=None, p_g: int = 0,
     ):
         """Stall-free admission on a pod: the fused prefill+decode packet
         goes out first (bucket implied by the chunk length, prefill header
@@ -566,8 +669,9 @@ class RootControlEngine:
         # the broadcast would leave worker rings permanently diverged
         p_topp = self._check_fused_chunk(chunk, p_topp)
         self._engine.check_fused_dispatch(
-            list(chunk), p_start, tokens is not None, positions
+            list(chunk), p_start, tokens is not None, positions, g_states
         )
+        g_states = self._engine._g_vec(g_states, tokens is not None)
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_prefill_fused(
             None if tokens is None else np.asarray(tokens, np.int32),
@@ -575,16 +679,18 @@ class RootControlEngine:
             depth=getattr(self._engine, "pipeline_depth", 2),
             p_lane=p_lane, chunk=list(chunk), p_start=p_start,
             p_temp=p_temp, p_topp=p_topp, p_seed=p_seed,
+            g_states=g_states, p_g=p_g,
         )
         return self._engine.decode_prefill_fused(
             positions, temps, topps, seeds,
             p_lane=p_lane, chunk=list(chunk), p_start=p_start,
             p_temp=p_temp, p_topp=p_topp, p_seed=p_seed, tokens=tokens,
+            g_states=g_states, p_g=p_g,
         )
 
     def decode_spec_pipelined(
         self, positions, drafts, draft_len, temps=None, topps=None,
-        seeds=None, tokens=None,
+        seeds=None, tokens=None, g_states=None,
     ):
         """Zero-flush speculation on a pod: the spec-verify packet goes
         out first (drafts + lengths in their own slots), then the root
@@ -596,25 +702,27 @@ class RootControlEngine:
         diverged (the pod-deadlock rule)."""
         drafts = np.asarray(drafts, np.int32)
         self._engine.check_spec_pipelined_dispatch(
-            drafts, tokens is not None, positions
+            drafts, tokens is not None, positions, g_states
         )
+        g_states = self._engine._g_vec(g_states, tokens is not None)
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_spec_pipelined(
             None if tokens is None else np.asarray(tokens, np.int32),
             np.asarray(positions, np.int32), temps, topps, seeds,
             depth=getattr(self._engine, "pipeline_depth", 2),
             drafts=drafts, draft_len=np.asarray(draft_len, np.int32),
+            g_states=g_states,
         )
         return self._engine.decode_spec_pipelined(
             positions, drafts, draft_len, temps, topps, seeds,
-            tokens=tokens,
+            tokens=tokens, g_states=g_states,
         )
 
     def decode_spec_prefill_fused(
         self, positions, drafts, draft_len, temps=None, topps=None,
         seeds=None, p_lane: int = 0, chunk=None, p_start: int = 0,
         p_temp: float = 0.0, p_topp: float | None = None, p_seed: int = 0,
-        tokens=None,
+        tokens=None, g_states=None, p_g: int = 0,
     ):
         """The full composition on a pod: an admitting chunk and a spec
         verify step replay as ONE packet. Validation is the union of the
@@ -624,8 +732,9 @@ class RootControlEngine:
         drafts = np.asarray(drafts, np.int32)
         self._engine.check_spec_drafts(drafts)
         self._engine.check_fused_dispatch(
-            list(chunk), p_start, tokens is not None, positions
+            list(chunk), p_start, tokens is not None, positions, g_states
         )
+        g_states = self._engine._g_vec(g_states, tokens is not None)
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_spec_prefill_fused(
             None if tokens is None else np.asarray(tokens, np.int32),
@@ -634,11 +743,13 @@ class RootControlEngine:
             drafts=drafts, draft_len=np.asarray(draft_len, np.int32),
             p_lane=p_lane, chunk=list(chunk), p_start=p_start,
             p_temp=p_temp, p_topp=p_topp, p_seed=p_seed,
+            g_states=g_states, p_g=p_g,
         )
         return self._engine.decode_spec_prefill_fused(
             positions, drafts, draft_len, temps, topps, seeds,
             p_lane=p_lane, chunk=list(chunk), p_start=p_start,
             p_temp=p_temp, p_topp=p_topp, p_seed=p_seed, tokens=tokens,
+            g_states=g_states, p_g=p_g,
         )
 
     def pipeline_flush(self) -> int:
@@ -667,29 +778,30 @@ class RootControlEngine:
 
     def decode_spec(
         self, tokens, drafts, draft_len, positions,
-        temps=None, topps=None, seeds=None,
+        temps=None, topps=None, seeds=None, g_states=None,
     ):
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_spec(
             np.asarray(tokens, np.int32), np.asarray(drafts, np.int32),
             np.asarray(draft_len, np.int32), np.asarray(positions, np.int32),
-            temps, topps, seeds,
+            temps, topps, seeds, g_states=g_states,
         )
         return self._engine.decode_spec(
-            tokens, drafts, draft_len, positions, temps, topps, seeds
+            tokens, drafts, draft_len, positions, temps, topps, seeds,
+            g_states=g_states,
         )
 
     def decode_multi(
         self, tokens, positions, temps=None, topps=None, seeds=None,
-        h: int = 8,
+        h: int = 8, g_states=None,
     ):
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
         self._plane.send_decode_multi(
             np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
-            temps, topps, seeds, h,
+            temps, topps, seeds, h, g_states=g_states,
         )
         return self._engine.decode_multi(
-            tokens, positions, temps, topps, seeds, h
+            tokens, positions, temps, topps, seeds, h, g_states=g_states
         )
 
     def measured_sync_stats(self, steps: int = 4) -> dict:
@@ -774,6 +886,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
 
     ``on_replay`` (if given) is called after each successfully replayed
     packet — ``worker_serve`` uses it to refresh its restart budget."""
+    gram_buf = bytearray()  # OP_GRAMMAR fragment accumulator
     while True:
         pkt = plane.recv()
         # header: [magic, version, op, lane, n, start_pos] — magic/version
@@ -789,6 +902,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 temp=float(plane.slot(pkt, 1, 1).view(np.float32)[0]),
                 topp=float(plane.slot(pkt, 2, 1).view(np.float32)[0]),
                 seed=int(plane.slot(pkt, 3, 1).view(np.uint32)[0]),
+                g_state=int(plane.slot(pkt, 4, 1)[0]),
             )
         elif op == OP_DECODE:
             engine.decode(
@@ -798,6 +912,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
                 want_logits=bool(lane),  # same compiled program as the root
+                g_states=plane.slot(pkt, 5, n),
             )
         elif op == OP_DECODE_PIPELINED:
             # feed flag rides `lane`, ring depth rides `start_pos`. The
@@ -814,17 +929,19 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
                 tokens=plane.slot(pkt, 0, n) if lane else None,
+                g_states=plane.slot(pkt, 5, n),
             )
         elif op == OP_DECODE_PREFILL_FUSED:
             # the pipelined replay rules (feed flag in `lane`, ring depth
             # in `start_pos`, bounded-lag consume) plus the prompt chunk +
-            # prefill header riding slots 5/6 — the worker dispatches the
-            # same per-bucket fused program the root did
+            # prefill header riding slots 5/6 and the grammar states in
+            # slot 7 — the worker dispatches the same per-bucket fused
+            # program the root did
             if lane:
                 engine.pipeline_flush(count=False)  # reseed: same lagged drain
             elif engine.pipeline_inflight() >= max(1, start_pos):
                 engine.pipeline_consume()
-            phdr = plane.slot(pkt, 6, 6)
+            phdr = plane.slot(pkt, 6, 7)
             engine.decode_prefill_fused(
                 plane.slot(pkt, 1, n),
                 plane.slot(pkt, 2, n).view(np.float32),
@@ -837,11 +954,13 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 p_topp=float(phdr[4:5].view(np.float32)[0]),
                 p_seed=int(phdr[5:6].view(np.uint32)[0]),
                 tokens=plane.slot(pkt, 0, n) if lane else None,
+                g_states=plane.slot(pkt, 7, n),
+                p_g=int(phdr[6]),
             )
         elif op == OP_DECODE_SPEC_PIPELINED:
             # the pipelined replay rules (feed flag in `lane`, ring depth
             # in `start_pos`, bounded-lag consume) with the in-chain
-            # drafts + lengths riding slots 5/6
+            # drafts + lengths riding slots 5/6, grammar states slot 7
             if lane:
                 engine.pipeline_flush(count=False)  # reseed: same lagged drain
             elif engine.pipeline_inflight() >= max(1, start_pos):
@@ -855,16 +974,18 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
                 tokens=plane.slot(pkt, 0, n) if lane else None,
+                g_states=plane.slot(pkt, 7, n),
             )
         elif op == OP_DECODE_SPEC_PREFILL_FUSED:
             # the SPEC_PIPELINED rules plus the chunk + prefill header in
-            # slots 7/8 — chunk and spec verify replay as one program
+            # slots 7/8 and the grammar states in slot 9 — chunk and spec
+            # verify replay as one program
             if lane:
                 engine.pipeline_flush(count=False)  # reseed: same lagged drain
             elif engine.pipeline_inflight() >= max(1, start_pos):
                 engine.pipeline_consume()
             k1 = engine.SPEC_DRAFT + 1
-            phdr = plane.slot(pkt, 8, 6)
+            phdr = plane.slot(pkt, 8, 7)
             engine.decode_spec_prefill_fused(
                 plane.slot(pkt, 1, n),
                 plane.slot(pkt, 5, n * k1).reshape(n, k1),
@@ -879,6 +1000,8 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 p_topp=float(phdr[4:5].view(np.float32)[0]),
                 p_seed=int(phdr[5:6].view(np.uint32)[0]),
                 tokens=plane.slot(pkt, 0, n) if lane else None,
+                g_states=plane.slot(pkt, 9, n),
+                p_g=int(phdr[6]),
             )
         elif op == OP_DECODE_SPEC:
             k = engine.SPEC_DRAFT
@@ -890,6 +1013,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 2, n).view(np.float32),
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
+                g_states=plane.slot(pkt, 7, n),
             )
         elif op == OP_DECODE_MULTI:
             engine.decode_multi(
@@ -899,7 +1023,26 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 plane.slot(pkt, 3, n).view(np.float32),
                 plane.slot(pkt, 4, n).view(np.uint32),
                 start_pos,  # horizon h rides the start_pos header field
+                g_states=plane.slot(pkt, 5, n),
             )
+        elif op == OP_GRAMMAR:
+            # grammar attach/detach: accumulate schema-byte fragments and
+            # act on the final one. Compiling is deterministic, so this
+            # worker's slab lands the automaton at the root's base. A
+            # worker without grammar_init (config skew: root on, worker
+            # off) raises the ValueError the attach path defines —
+            # request-scoped on the root, a restartable replay error here.
+            frag = plane.slot(pkt, 0, (n + 3) // 4).view(np.uint8)[:n]
+            gram_buf += frag.tobytes()
+            if lane & 1:  # final fragment
+                blob = bytes(gram_buf)
+                gram_buf = bytearray()
+                if lane & 2:
+                    engine.grammar_detach(blob.decode())
+                else:
+                    import json as _json
+
+                    engine.grammar_attach(_json.loads(blob))
         elif op == OP_PIPELINE_FLUSH:
             # the root ended/aborted a pipelined chain: drop this worker's
             # lagged ring + carry so no stale step survives into the next

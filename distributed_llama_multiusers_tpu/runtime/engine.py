@@ -30,6 +30,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..grammar.slab import (
+    DEFAULT_SLAB_EDGES,
+    DEFAULT_SLAB_STATES,
+    GrammarSlab,
+)
 from ..lockcheck import make_lock
 from ..models.config import LlamaConfig
 from ..models.llama import (
@@ -128,6 +133,12 @@ class EngineStats:
     # worker health is a stats read, not a stderr grep
     worker_restarts: int = 0
     worker_replay_errors: int = 0
+    # grammar-constrained decoding (grammar/): admissions that attached a
+    # compiled automaton, and dispatches that carried at least one
+    # constrained lane (every step family threads the mask; these count
+    # the ones where it actually bit)
+    grammar_lanes: int = 0
+    grammar_masked_steps: int = 0
     # writers (engine hot paths, scheduler counters) hold this around their
     # multi-field bumps; snapshot()/reset() hold it while copying, so a
     # /stats read sees one consistent point in time instead of field-by-field
@@ -155,6 +166,7 @@ class EngineStats:
             "fused_steps", "admission_stall_s", "fused_bucket_hist",
             "sync_bytes_per_decode", "sync_collectives_per_decode",
             "sync_bytes_total", "worker_restarts", "worker_replay_errors",
+            "grammar_lanes", "grammar_masked_steps",
         ),
     }
 
@@ -189,6 +201,7 @@ class EngineStats:
             self.fused_bucket_hist = {}
             self.sync_bytes_total = 0
             self.worker_restarts = self.worker_replay_errors = 0
+            self.grammar_lanes = self.grammar_masked_steps = 0
             # per-decode sync_* stay: they describe the compiled program,
             # not a window
         return snap
@@ -228,6 +241,8 @@ class InferenceEngine:
         kv_page_size: int = DEFAULT_PAGE_SIZE,
         kv_pool_pages: int | None = None,
         kv_max_parked: int = DEFAULT_MAX_PARKED,
+        grammar_slab_states: int | None = None,
+        grammar_slab_edges: int | None = None,
     ):
         """``paged_kv=True`` stores KV as a pooled set of fixed-size pages
         behind a per-lane page table (runtime/kvpool.py) instead of
@@ -346,6 +361,35 @@ class InferenceEngine:
         # carried position; >= 0 overrides from host metadata (parked /
         # admitting / freshly reseeded lanes).
         self._pl_carry_pos = None
+        # [n] device int32: each lane's grammar-automaton state (absolute
+        # slab id; 0 = FREE/unconstrained), advanced ON DEVICE by every
+        # chosen token exactly like the position carry — same -1/override
+        # dispatch semantics, so constrained lanes ride the zero-flush
+        # chain without any host round-trip
+        self._pl_carry_g = None
+        # grammar slab (grammar/slab.py): fixed-capacity mask + transition
+        # tables, state 0 = FREE (all-ones mask) so unconstrained lanes run
+        # the identical compiled math. Device copies upload lazily on slab
+        # version bumps (admissions of new schemas) — shapes never change,
+        # so grammar churn can never trigger an XLA recompile.
+        self.grammar_slab = GrammarSlab(
+            config.vocab_size,
+            n_states=grammar_slab_states or DEFAULT_SLAB_STATES,
+            n_edges=grammar_slab_edges or DEFAULT_SLAB_EDGES,
+        )
+        self._g_dev = None
+        self._g_version = -1
+        self._g_vocab = None  # token piece table (grammar_init)
+        self._g_vocab_key = None
+        self._g_eos: tuple = ()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # the slab tables are small and read by every chip: fully
+            # replicated, like the token carries
+            self._g_sharding = NamedSharding(mesh, PartitionSpec())
+        else:
+            self._g_sharding = None
 
         cfg = config
         q80 = emulate_q80_activations
@@ -382,6 +426,71 @@ class InferenceEngine:
             rep_tokens = lambda x: jax.lax.with_sharding_constraint(x, _tok_rep)
         else:
             rep_tokens = lambda x: x
+
+        # grammar-constrained decoding (grammar/): per-state packed legal-
+        # token masks + compact transitions, gathered INSIDE the compiled
+        # step. ``gtab`` = (masks [S, ceil(V/32)] u32, edge_keys [E] i32
+        # sorted as state*V+token, edge_next [E] i32, default_next [S]
+        # i32) rides every family as an operand (device-resident, updated
+        # only on schema admission); ``gs``/``g`` are per-lane automaton
+        # states — 0 is the FREE state (all-ones mask, self-loop), so
+        # unconstrained lanes run the identical math and their streams
+        # stay byte-identical by construction.
+        _g_tok_ids = jnp.arange(cfg.vocab_size, dtype=jnp.uint32)
+
+        def _g_bits(gtab, g):
+            row = gtab[0][g]  # [ceil(V/32)] uint32
+            return (
+                (row[_g_tok_ids >> 5] >> (_g_tok_ids & jnp.uint32(31)))
+                & jnp.uint32(1)
+            ).astype(jnp.bool_)
+
+        def _g_mask_row(gtab, g, row):
+            # -inf outside the state's legal set: the masked row feeds the
+            # SAME argmax + full-vocab sort/cumsum/categorical as before
+            return jnp.where(_g_bits(gtab, g), row, -jnp.inf)
+
+        _g_mask_rows = jax.vmap(_g_mask_row, in_axes=(None, 0, 0))
+
+        def _g_next1(gtab, g, tok):
+            # compact transition: sorted sparse exceptions, else the
+            # state's majority target. Illegal tokens (never chosen — the
+            # mask excluded them) land on the bounded default.
+            keys, nxt, dflt = gtab[1], gtab[2], gtab[3]
+            key = g * cfg.vocab_size + tok
+            j = jnp.clip(jnp.searchsorted(keys, key), 0, keys.shape[0] - 1)
+            return jnp.where(keys[j] == key, nxt[j], dflt[g]).astype(
+                jnp.int32
+            )
+
+        _g_next = jax.vmap(_g_next1, in_axes=(None, 0, 0))
+        self._g_next_host = _g_next1  # pod-free debug/testing surface
+
+        def _g_walk_greedy(gtab, gs, logits, full):
+            """Per-position masked greedy + grammar state walk over a
+            spec verify window: g_t applies to ``logits[:, t]`` and
+            advances by the FED token ``full[:, t+1]`` (teacher-forced;
+            along the accepted prefix fed == emitted so the walk is
+            exact, past the first mismatch the states are junk nothing
+            consumes). ONE implementation shared by the sync and
+            in-chain verify cores, so the acceptance rule cannot drift
+            between them. Returns (masked greedy [n, K], states [n, K])."""
+            rows = jnp.moveaxis(logits, 1, 0)  # [K, n, V]
+            fed_next = jnp.concatenate(
+                [full[:, 1:], jnp.zeros_like(full[:, :1])], axis=1
+            ).T  # [K, n]; last row junk (no t+1)
+
+            def _walk(g, xs):
+                row_t, fed_t = xs
+                mg = jnp.argmax(
+                    _g_mask_rows(gtab, g, row_t), axis=-1
+                ).astype(jnp.int32)
+                return _g_next(gtab, g, fed_t), (mg, g)
+
+            _, (mgreedy, gstates) = jax.lax.scan(
+                _walk, gs, (rows, fed_next)
+            )
+            return mgreedy.T, gstates.T
 
         # EXACT on-device top-p: the nucleus is computed over the FULL
         # vocab (top_k with k == vocab_size is a total descending sort), so
@@ -438,25 +547,36 @@ class InferenceEngine:
                 lambda: greedy,
             )
 
-        def _decode_core(params, cache, tokens, positions, temps, topps, seeds):
+        def _decode_core(params, cache, tokens, positions, temps, topps,
+                         seeds, gtab, gs):
             # tokens/positions: [n_lanes] -> [n_lanes, 1]
             logits, cache = llama_forward(
                 cfg, params, tokens[:, None], positions[:, None], cache,
                 emulate_q80_activations=q80, mesh=sp_mesh, q80_sync=q80s,
             )
             step = logits[:, 0, :]
-            greedy = jnp.argmax(step, axis=-1).astype(jnp.int32)
+            # grammar mask BEFORE both the argmax and the exact top-p sort:
+            # constrained lanes' greedy continuation IS the masked argmax.
+            # FREE lanes (gs == 0) see an all-ones mask — identity.
+            mstep = _g_mask_rows(gtab, gs, step)
+            greedy = jnp.argmax(mstep, axis=-1).astype(jnp.int32)
             # sampling fused into the compiled step: a sampled lane costs a
             # 4-byte token transfer, not a [vocab] f32 row (VERDICT Weak #3)
             sampled = _sample_lanes_or_greedy(
-                step, temps, topps, seeds, positions, greedy
+                mstep, temps, topps, seeds, positions, greedy
             )
-            return step, greedy, sampled, cache
+            # the automaton advances on the CHOSEN token, on device — the
+            # grammar twin of the position carry
+            chosen = jnp.where(temps == 0.0, greedy, sampled)
+            new_g = _g_next(gtab, gs, chosen)
+            return step, greedy, sampled, new_g, cache
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _decode(params, cache, tokens, positions, temps, topps, seeds):
-            step, greedy, sampled, cache = _decode_core(
-                params, cache, tokens, positions, temps, topps, seeds
+        def _decode(params, cache, tokens, positions, temps, topps, seeds,
+                    gtab, gs):
+            step, greedy, sampled, _, cache = _decode_core(
+                params, cache, tokens, positions, temps, topps, seeds,
+                gtab, gs,
             )
             # greedy+sampled stacked into ONE [2, n] array: a decode step
             # costs a single device->host round trip, not two (the transfer
@@ -469,13 +589,14 @@ class InferenceEngine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def _decode_nologits(params, cache, tokens, positions, temps, topps,
-                             seeds):
+                             seeds, gtab, gs):
             # the common all-device-sampling step: no [n, vocab] output kept
             # alive (the row is still computed for argmax, but never
             # materialized as a program output, so it pins no HBM and — in
             # the pipelined path — can never force a sync)
-            _, greedy, sampled, cache = _decode_core(
-                params, cache, tokens, positions, temps, topps, seeds
+            _, greedy, sampled, _, cache = _decode_core(
+                params, cache, tokens, positions, temps, topps, seeds,
+                gtab, gs,
             )
             return rep_tokens(jnp.stack([greedy, sampled])), cache
 
@@ -486,31 +607,37 @@ class InferenceEngine:
             # spec verify step with a per-lane accept count is in flight
             return jnp.where(pos_host < 0, carry_pos, pos_host)
 
+        # the grammar-state select is the identical rule (-1 = carry)
+        _eff_g = _eff_positions
+
         @partial(jax.jit, donate_argnums=(1,))
         def _decode_pl(params, cache, tokens, carry_pos, positions, temps,
-                       topps, seeds):
+                       topps, seeds, gtab, carry_g, gs_host):
             # pipelined step: the per-lane feed rule (greedy lanes continue
             # with argmax, device-sampled lanes with the fused sample — the
             # same select the decode_multi scan body applies) runs ON DEVICE
             # and comes back as the carry for the NEXT dispatch, so step k+1
             # needs no host readback of step k at all. Positions ride the
             # carry too (clamped at seq_len, where the KV scatter drops
-            # writes — the same park rule the host applies).
+            # writes — the same park rule the host applies); the grammar
+            # state rides it identically.
             pos = _eff_positions(carry_pos, positions)
-            _, greedy, sampled, cache = _decode_core(
-                params, cache, tokens, pos, temps, topps, seeds
+            gs = _eff_g(carry_g, gs_host)
+            _, greedy, sampled, new_g, cache = _decode_core(
+                params, cache, tokens, pos, temps, topps, seeds, gtab, gs
             )
             nxt = jnp.where(temps == 0.0, greedy, sampled)
             new_pos = jnp.minimum(pos + 1, cfg.seq_len)
             return (
                 rep_tokens(nxt),
                 rep_tokens(new_pos),
+                rep_tokens(new_g),
                 rep_tokens(jnp.stack([greedy, sampled])),
                 cache,
             )
 
         def _spec_verify_core(params, cache, feed, pos, drafts, draft_len,
-                              temps, topps, seeds):
+                              temps, topps, seeds, gtab, gs):
             """Speculative verify INSIDE the pipelined step family: up to
             SPEC_DRAFT host-shipped draft tokens are verified against the
             device's own carry in one forward, per-lane accepted counts
@@ -532,7 +659,18 @@ class InferenceEngine:
             Junk-KV safety is ``_decode_spec``'s contract verbatim, with
             the draft clamp moved ON DEVICE (the host's stale position
             could under-clamp): eff_len <= seq_len - pos - 1, and writes
-            at >= seq_len drop in the cache scatter."""
+            at >= seq_len drop in the cache scatter.
+
+            Grammar: the automaton state WALKS the verify window — the
+            state for window position t is ``gs`` advanced by the fed
+            tokens ``full[1..t]``, so each position's greedy is the
+            MASKED argmax under its own state (a constrained lane's
+            "model's own greedy path" is the masked one; FREE lanes see
+            identity masks). Along the accepted prefix the fed tokens
+            equal the masked greedy, so the walk is exact; past the first
+            mismatch the states are junk that nothing consumes. The new
+            carry is the state after the accepted prefix plus the model's
+            own continuation token."""
             hit0 = (drafts[:, 0] == feed) & (draft_len > 0)
             eff_len = jnp.where(hit0, draft_len - 1, 0)
             eff_len = jnp.clip(
@@ -545,7 +683,12 @@ class InferenceEngine:
                 cfg, params, full, pos2d, cache,
                 emulate_q80_activations=q80, mesh=sp_mesh, q80_sync=q80s,
             )
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            # per-position masked greedy + state walk (K is tiny: a short
+            # scan, not a flush-worthy cost) — the shared verify-window
+            # rule, so sync and in-chain acceptance cannot drift
+            greedy, gstates = _g_walk_greedy(gtab, gs, logits, full)
+
             match = (full[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
             lead = jnp.cumprod(match, axis=1)
             in_draft = (
@@ -555,7 +698,8 @@ class InferenceEngine:
             accepted = jnp.sum(lead * in_draft, axis=1).astype(jnp.int32)
             n_emit = accepted + 1
             sampled0 = _sample_lanes_or_greedy(
-                logits[:, 0, :], temps, topps, seeds, pos, greedy[:, 0]
+                _g_mask_rows(gtab, gs, logits[:, 0, :]),
+                temps, topps, seeds, pos, greedy[:, 0],
             )
             emitted = greedy.at[:, 0].set(
                 jnp.where(temps > 0.0, sampled0, greedy[:, 0])
@@ -563,22 +707,31 @@ class InferenceEngine:
             nxt = jnp.take_along_axis(
                 emitted, (n_emit - 1)[:, None], axis=1
             )[:, 0]
+            # grammar carry: state after full[0..accepted] (the walk's
+            # entry at index `accepted`), advanced by the continuation
+            g_a = jnp.take_along_axis(
+                gstates, accepted[:, None], axis=1
+            )[:, 0]
+            new_g = _g_next(gtab, g_a, nxt)
             new_pos = jnp.minimum(pos + n_emit, cfg.seq_len)
             # ONE [n, K+2] lagged transfer: emitted tokens + emit count
             packed = jnp.concatenate([emitted, n_emit[:, None]], axis=1)
-            return nxt, new_pos, packed, cache
+            return nxt, new_pos, new_g, packed, cache
 
         @partial(jax.jit, donate_argnums=(1,))
         def _decode_spec_pl(params, cache, tokens, carry_pos, positions,
-                            drafts, draft_len, temps, topps, seeds):
+                            drafts, draft_len, temps, topps, seeds, gtab,
+                            carry_g, gs_host):
             pos = _eff_positions(carry_pos, positions)
-            nxt, new_pos, packed, cache = _spec_verify_core(
+            gs = _eff_g(carry_g, gs_host)
+            nxt, new_pos, new_g, packed, cache = _spec_verify_core(
                 params, cache, tokens, pos, drafts, draft_len, temps,
-                topps, seeds,
+                topps, seeds, gtab, gs,
             )
             return (
                 rep_tokens(nxt),
                 rep_tokens(new_pos),
+                rep_tokens(new_g),
                 rep_tokens(packed),
                 cache,
             )
@@ -587,7 +740,8 @@ class InferenceEngine:
         def _decode_spec_prefill(params, cache, tokens, carry_pos,
                                  positions, drafts, draft_len, temps, topps,
                                  seeds, p_lane, p_tokens, p_start, p_n,
-                                 p_temp, p_topp, p_seed):
+                                 p_temp, p_topp, p_seed, gtab, carry_g,
+                                 gs_host, p_g):
             """Fused admission + speculative verify: ONE dispatch that
             consumes one bounded prompt chunk for lane ``p_lane`` AND
             verifies every generating lane's drafts — the composition the
@@ -600,29 +754,35 @@ class InferenceEngine:
             [2, n+1] column pack of the plain fused step)."""
             _, p_greedy, p_sampled, cache = _prefill_half(
                 params, cache, p_lane, p_tokens, p_start, p_n,
-                p_temp, p_topp, p_seed,
+                p_temp, p_topp, p_seed, gtab, p_g,
             )
             pos = _eff_positions(carry_pos, positions)
-            nxt, new_pos, packed, cache = _spec_verify_core(
+            gs = _eff_g(carry_g, gs_host)
+            nxt, new_pos, new_g, packed, cache = _spec_verify_core(
                 params, cache, tokens, pos, drafts, draft_len, temps,
-                topps, seeds,
+                topps, seeds, gtab, gs,
             )
             p_first = jnp.where(p_temp == 0.0, p_greedy, p_sampled)
             nxt = nxt.at[p_lane].set(p_first)
             new_pos = new_pos.at[p_lane].set(p_start + p_n)
+            # the admitting lane's grammar carry: its automaton start
+            # state advanced by the boundary token (junk mid-prompt, the
+            # final chunk's dispatch overwrites it — the token-carry rule)
+            new_g = new_g.at[p_lane].set(_g_next1(gtab, p_g, p_first))
             brow = jnp.zeros((1, packed.shape[1]), jnp.int32)
             brow = brow.at[0, 0].set(p_greedy).at[0, 1].set(p_sampled)
             packed = jnp.concatenate([packed, brow], axis=0)
             return (
                 rep_tokens(nxt),
                 rep_tokens(new_pos),
+                rep_tokens(new_g),
                 rep_tokens(packed),
                 cache,
             )
 
         @partial(jax.jit, donate_argnums=(1,))
         def _decode_spec(params, cache, tokens, drafts, draft_len, positions,
-                         temps, topps, seeds):
+                         temps, topps, seeds, gtab, gs):
             """Speculative decode: verify K = 1 + n_draft tokens per lane in
             ONE forward (prompt-lookup speculation — decode is weight-read-
             bound, so a K-token step costs the same HBM traffic as a 1-token
@@ -651,7 +811,9 @@ class InferenceEngine:
                 cfg, params, full, pos2d, cache,
                 emulate_q80_activations=q80, mesh=sp_mesh, q80_sync=q80s,
             )
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [n, K]
+            # per-position masked greedy via the SHARED grammar state
+            # walk (the _spec_verify_core rule; identity for FREE lanes)
+            greedy, _ = _g_walk_greedy(gtab, gs, logits, full)
             match = (full[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
             lead = jnp.cumprod(match, axis=1)  # leading-match indicator
             in_draft = (
@@ -662,7 +824,8 @@ class InferenceEngine:
             n_emit = accepted + 1  # [n]
             # lane 0-position sample for temp>0 lanes (their draft_len is 0)
             sampled0 = _sample_lanes_or_greedy(
-                logits[:, 0, :], temps, topps, seeds, positions, greedy[:, 0]
+                _g_mask_rows(gtab, gs, logits[:, 0, :]),
+                temps, topps, seeds, positions, greedy[:, 0],
             )
             emitted = greedy.at[:, 0].set(
                 jnp.where(temps > 0.0, sampled0, greedy[:, 0])
@@ -674,7 +837,7 @@ class InferenceEngine:
         self._decode_spec_fn = _decode_spec
 
         def _prefill_half(params, cache, lane, tokens, start_pos, n_tokens,
-                          temp, topp, seed):
+                          temp, topp, seed, gtab, p_g):
             """The prompt-chunk math shared by ``_prefill`` and the fused
             ``_decode_prefill``: lane slice, forward, KV splice, boundary
             argmax + fused sample. ONE implementation, so the fused
@@ -728,13 +891,17 @@ class InferenceEngine:
                 v = jax.lax.dynamic_update_slice_in_dim(cache.v, lane_cache.v, lane, axis=1)
                 out_cache = KVCache(k=k, v=v)
             last = jax.lax.dynamic_index_in_dim(logits[0], n_tokens - 1, axis=0, keepdims=False)
-            greedy = jnp.argmax(last).astype(jnp.int32)
+            # grammar: the boundary token — the request's FIRST generated
+            # token when this is the final chunk — samples under the
+            # automaton's start-state mask (p_g; 0 = FREE = identity)
+            mlast = _g_mask_row(gtab, p_g, last)
+            greedy = jnp.argmax(mlast).astype(jnp.int32)
             # same runtime gate as the decode families: a greedy admission
             # (temp 0) skips the full-vocab sampler sort entirely
             sampled = jax.lax.cond(
                 temp > 0.0,
                 lambda: _sample_lane(
-                    last, temp, topp, seed, start_pos + n_tokens - 1, greedy
+                    mlast, temp, topp, seed, start_pos + n_tokens - 1, greedy
                 ),
                 lambda: greedy,
             )
@@ -742,10 +909,10 @@ class InferenceEngine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def _prefill(params, cache, lane, tokens, start_pos, n_tokens,
-                     temp, topp, seed):
+                     temp, topp, seed, gtab, p_g):
             last, greedy, sampled, cache = _prefill_half(
                 params, cache, lane, tokens, start_pos, n_tokens,
-                temp, topp, seed,
+                temp, topp, seed, gtab, p_g,
             )
             return (
                 replicate(last),
@@ -756,7 +923,8 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(1,))
         def _decode_prefill(params, cache, feed, carry_pos, positions,
                             temps, topps, seeds, p_lane, p_tokens, p_start,
-                            p_n, p_temp, p_topp, p_seed):
+                            p_n, p_temp, p_topp, p_seed, gtab, carry_g,
+                            gs_host, p_g):
             """Fused prefill+decode: ONE device dispatch that consumes one
             bucketed prompt chunk for lane ``p_lane`` AND advances every
             generating lane one pipelined decode step — the stall-free
@@ -784,11 +952,12 @@ class InferenceEngine:
             column."""
             _, p_greedy, p_sampled, cache = _prefill_half(
                 params, cache, p_lane, p_tokens, p_start, p_n,
-                p_temp, p_topp, p_seed,
+                p_temp, p_topp, p_seed, gtab, p_g,
             )
             pos = _eff_positions(carry_pos, positions)
-            _, greedy, sampled, cache = _decode_core(
-                params, cache, feed, pos, temps, topps, seeds
+            gs = _eff_g(carry_g, gs_host)
+            _, greedy, sampled, new_g, cache = _decode_core(
+                params, cache, feed, pos, temps, topps, seeds, gtab, gs
             )
             nxt = jnp.where(temps == 0.0, greedy, sampled)
             # host-exact admissions never take the fused path, so the
@@ -800,6 +969,9 @@ class InferenceEngine:
             # carried on device so the lane can ride spec steps immediately
             new_pos = jnp.minimum(pos + 1, cfg.seq_len)
             new_pos = new_pos.at[p_lane].set(p_start + p_n)
+            # its grammar carry joins the same way: start state advanced
+            # by the boundary token (junk mid-prompt; final chunk wins)
+            new_g = new_g.at[p_lane].set(_g_next1(gtab, p_g, p_first))
             packed = jnp.concatenate(
                 [
                     jnp.stack([greedy, sampled]),
@@ -810,6 +982,7 @@ class InferenceEngine:
             return (
                 rep_tokens(nxt),
                 rep_tokens(new_pos),
+                rep_tokens(new_g),
                 rep_tokens(packed),
                 cache,
             )
@@ -850,7 +1023,7 @@ class InferenceEngine:
         def _make_decode_multi(h):
             @partial(jax.jit, donate_argnums=(1,))
             def _decode_multi(params, cache, tokens, positions, temps, topps,
-                              seeds):
+                              seeds, gtab, gs):
                 """h chained decode steps in ONE device program (lax.scan):
                 greedy lanes feed argmax forward, device-sampled lanes feed
                 their fused sample (same fold_in(seed, pos) stream as h
@@ -860,24 +1033,26 @@ class InferenceEngine:
                 per-token dispatch overhead drops by h. Host-side EOS/stop
                 handling is retroactive: steps past a lane's stop write
                 junk KV that the overwrite-before-readable invariant
-                (chunked prefill, spec verify) already covers."""
+                (chunked prefill, spec verify) already covers. The grammar
+                state threads the scan carry like the position does."""
                 def body(carry, _):
-                    tok, pos, cache = carry
+                    tok, pos, g, cache = carry
                     logits, cache = llama_forward(
                         cfg, params, tok[:, None], pos[:, None], cache,
                         emulate_q80_activations=q80, mesh=sp_mesh,
                         q80_sync=q80s,
                     )
                     step = logits[:, 0, :]
-                    greedy = jnp.argmax(step, axis=-1).astype(jnp.int32)
+                    mstep = _g_mask_rows(gtab, g, step)
+                    greedy = jnp.argmax(mstep, axis=-1).astype(jnp.int32)
                     sampled = _sample_lanes_or_greedy(
-                        step, temps, topps, seeds, pos, greedy
+                        mstep, temps, topps, seeds, pos, greedy
                     )
                     nxt = jnp.where(temps == 0.0, greedy, sampled)
-                    return (nxt, pos + 1, cache), nxt
+                    return (nxt, pos + 1, _g_next(gtab, g, nxt), cache), nxt
 
-                (_, _, cache), chosen = jax.lax.scan(
-                    body, (tokens, positions, cache), None, length=h
+                (_, _, _, cache), chosen = jax.lax.scan(
+                    body, (tokens, positions, gs, cache), None, length=h
                 )
                 return rep_tokens(chosen), cache  # chosen [h, n]
 
@@ -899,6 +1074,95 @@ class InferenceEngine:
         # so --benchmark mesh runs don't compile the decode step twice
         self._decode_exec = None
 
+    # -- grammar-constrained decoding (grammar/) ----------------------------
+
+    # the scheduler gates response_format requests on this; pod roots
+    # broadcast attach/detach as OP_GRAMMAR packets (RootControlEngine)
+    @property
+    def supports_grammar(self) -> bool:
+        return self._g_vocab is not None
+
+    def grammar_init(self, token_table, eos_ids) -> None:
+        """Register the tokenizer's piece table (raw bytes per token id,
+        None for special tokens) + EOS ids — what the automaton compiler
+        walks. Model vocab padding beyond the tokenizer table compiles as
+        illegal-everywhere. Without this call, ``response_format``
+        requests are refused (the --grammar off escape hatch)."""
+        from ..grammar.automaton import vocab_fingerprint
+
+        table = list(token_table)[: self.config.vocab_size]
+        table += [None] * (self.config.vocab_size - len(table))
+        self._g_vocab = table
+        self._g_vocab_key = vocab_fingerprint(table)
+        self._g_eos = tuple(int(e) for e in eos_ids)  # dlint: ok[host-sync] eos_ids are host ints from the tokenizer, never device values
+
+    def grammar_attach(self, rf: dict):
+        """Compile ``response_format`` (cached per (vocab, schema)) and
+        install it into the slab; returns the :class:`~..grammar.slab.
+        SlabHandle` whose ``start_state`` the lane's grammar carry seeds
+        from. Raises the ValueError family (GrammarError) on a bad
+        schema — request-scoped, a 400 — and
+        :class:`~..grammar.slab.GrammarSlabFull` when live schemas
+        exhaust the slab (load: the scheduler sheds it retryably)."""
+        if self._g_vocab is None:
+            raise ValueError(
+                "structured output is disabled on this engine "
+                "(--grammar off, or no tokenizer vocab registered)"
+            )
+        from ..grammar.automaton import compile_automaton
+
+        auto = compile_automaton(
+            rf, self._g_vocab, self._g_eos, vocab_key=self._g_vocab_key
+        )
+        handle = self.grammar_slab.attach(auto)
+        with self.stats.lock:
+            self.stats.grammar_lanes += 1
+        return handle
+
+    def grammar_detach(self, key: str) -> None:
+        """Release one attach reference (the tables park for the next
+        same-schema admission; evicted only under slab pressure)."""
+        self.grammar_slab.detach(key)
+
+    def grammar_stats(self) -> dict:
+        """Slab pressure snapshot for /stats; {} when grammar is off."""
+        return (
+            self.grammar_slab.stats() if self._g_vocab is not None else {}
+        )
+
+    def _gtab(self):
+        """The slab's device copies, re-uploaded only when the slab
+        version moved (a new schema installed / an entry evicted) —
+        shapes are capacity-fixed, so this is never a recompile."""
+        if self._g_version != self.grammar_slab.version:
+            masks, ek, en, dflt = self.grammar_slab.arrays()
+            if self._g_sharding is None:
+                self._g_dev = tuple(
+                    jnp.asarray(a) for a in (masks, ek, en, dflt)
+                )
+            else:
+                # multi-process pods: build the replicated leaves from
+                # each process's (identical) host mirror, like _table_leaf
+                self._g_dev = tuple(
+                    jax.make_array_from_callback(
+                        a.shape, self._g_sharding,
+                        lambda idx, a=a: a[idx],
+                    )
+                    for a in (masks, ek, en, dflt)
+                )
+            self._g_version = self.grammar_slab.version
+        return self._g_dev
+
+    def _g_vec(self, g_states, reseed: bool) -> np.ndarray:
+        """Default grammar-state vector: all-FREE on a reseed (there is
+        no carry), all-carry (-1) on a chained dispatch — so engines
+        serving no constrained lane behave exactly as before."""
+        if g_states is not None:
+            return g_states
+        if reseed:
+            return np.zeros(self.n_lanes, np.int32)
+        return np.full(self.n_lanes, -1, np.int32)
+
     # -- public API ---------------------------------------------------------
 
     def bucket_for(self, n: int) -> int:
@@ -918,6 +1182,7 @@ class InferenceEngine:
         temp: float = 0.0,
         topp: float = DEFAULT_TOPP,
         seed: int = 0,
+        g_state: int = 0,
     ):
         """One bucketed prompt chunk for one lane — the unit the scheduler
         interleaves between decode steps so active lanes never stall more
@@ -946,6 +1211,8 @@ class InferenceEngine:
             jnp.float32(temp),
             jnp.float32(topp),
             jnp.uint32(seed & 0xFFFFFFFF),
+            self._gtab(),
+            jnp.int32(g_state),
         )
         # dlint: ok[host-sync] the one [2] int32 readback per prefill chunk (greedy+sampled), counted below
         toks_np = np.asarray(toks)
@@ -965,6 +1232,7 @@ class InferenceEngine:
         temp: float = 0.0,
         topp: float = DEFAULT_TOPP,
         seed: int = 0,
+        g_state: int = 0,
     ):
         """Process a full prompt on one lane in bucketed chunks. Returns
         (last_logits np[vocab], greedy_token int, total_positions)."""
@@ -977,7 +1245,8 @@ class InferenceEngine:
             chunk = remaining[: self.max_chunk()]
             remaining = remaining[len(chunk) :]
             last, greedy, self.last_sampled = self.prefill_chunk(
-                lane, chunk, pos, temp=temp, topp=topp, seed=seed
+                lane, chunk, pos, temp=temp, topp=topp, seed=seed,
+                g_state=g_state,
             )
             pos += len(chunk)
         return last, greedy, pos
@@ -990,6 +1259,7 @@ class InferenceEngine:
         topps: np.ndarray | None = None,
         seeds: np.ndarray | None = None,
         want_logits: bool = True,
+        g_states: np.ndarray | None = None,
     ):
         """One decode step for all lanes. tokens/positions: int32 [n_lanes]
         (idle lanes: any in-range position; their writes are never readable).
@@ -1010,6 +1280,8 @@ class InferenceEngine:
             seeds = np.zeros(n, np.uint32)
         faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
         t0 = time.perf_counter()
+        if g_states is None:
+            g_states = np.zeros(n, np.int32)
         operands = (
             self.params,
             self.cache,
@@ -1018,6 +1290,8 @@ class InferenceEngine:
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(topps, jnp.float32),
             jnp.asarray(seeds, jnp.uint32),
+            self._gtab(),
+            jnp.asarray(g_states, jnp.int32),
         )
         if want_logits:
             fn = self._decode_exec if self._decode_exec is not None else self._decode_fn
@@ -1046,6 +1320,7 @@ class InferenceEngine:
         topps: np.ndarray | None = None,
         seeds: np.ndarray | None = None,
         h: int = 8,
+        g_states: np.ndarray | None = None,
     ) -> np.ndarray:
         """``h`` chained decode steps for all lanes in one device dispatch.
 
@@ -1068,6 +1343,8 @@ class InferenceEngine:
             topps = np.full(n, DEFAULT_TOPP, np.float32)
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
+        if g_states is None:
+            g_states = np.zeros(n, np.int32)
         fn = self._decode_multi_fns.get(h)
         if fn is None:
             fn = self._decode_multi_fns[h] = self._make_decode_multi(h)
@@ -1081,6 +1358,8 @@ class InferenceEngine:
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(topps, jnp.float32),
             jnp.asarray(seeds, jnp.uint32),
+            self._gtab(),
+            jnp.asarray(g_states, jnp.int32),
         )
         # dlint: ok[host-sync] the ONE [h, n] int32 readback per multi-step dispatch, counted below
         chosen_np = np.asarray(chosen)
@@ -1112,6 +1391,7 @@ class InferenceEngine:
         topps: np.ndarray | None = None,
         seeds: np.ndarray | None = None,
         tokens: np.ndarray | None = None,
+        g_states: np.ndarray | None = None,
     ) -> None:
         """Dispatch ONE pipelined decode step and return without reading
         anything back (JAX async dispatch queues the program immediately).
@@ -1142,10 +1422,12 @@ class InferenceEngine:
             topps = np.full(n, DEFAULT_TOPP, np.float32)
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
-        self.check_pipelined_dispatch(tokens is not None, positions)
+        self.check_pipelined_dispatch(tokens is not None, positions,
+                                      g_states)
         faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
-        feed, carry_pos = self._pl_feed(tokens, positions)
-        nxt, new_pos, packed, self.cache = self._decode_pl_fn(
+        g_states = self._g_vec(g_states, tokens is not None)
+        feed, carry_pos, carry_g = self._pl_feed(tokens, positions)
+        nxt, new_pos, new_g, packed, self.cache = self._decode_pl_fn(
             self.params,
             self.cache,
             feed,
@@ -1154,9 +1436,13 @@ class InferenceEngine:
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(topps, jnp.float32),
             jnp.asarray(seeds, jnp.uint32),
+            self._gtab(),
+            carry_g,
+            jnp.asarray(g_states, jnp.int32),
         )
         self._pl_carry = nxt
         self._pl_carry_pos = new_pos
+        self._pl_carry_g = new_g
         self._pl_inflight.append(("tok", packed, time.perf_counter()))
         with self.stats.lock:
             self.stats.pipeline_dispatches += 1
@@ -1170,20 +1456,20 @@ class InferenceEngine:
     supports_fused_prefill = True
 
     def _pl_feed(self, tokens, positions):
-        """Resolve the (feed tokens, carried positions) operand pair for a
-        pipelined-family dispatch: the device carry when chained
-        (``tokens is None``), host arrays on a reseed — where the carried-
-        position operand is a zeros placeholder the ``-1`` select never
-        reads, because a reseed must pass real positions everywhere."""
+        """Resolve the (feed tokens, carried positions, carried grammar
+        states) operand triple for a pipelined-family dispatch: the
+        device carries when chained (``tokens is None``), host arrays on
+        a reseed — where the carried operands are zeros placeholders the
+        ``-1`` selects never read, because a reseed must pass real
+        positions (and grammar states) everywhere."""
         if tokens is None:
-            return self._pl_carry, self._pl_carry_pos
-        return (
-            jnp.asarray(tokens, jnp.int32),
-            jnp.zeros(self.n_lanes, jnp.int32),
-        )
+            return self._pl_carry, self._pl_carry_pos, self._pl_carry_g
+        z = jnp.zeros(self.n_lanes, jnp.int32)
+        return jnp.asarray(tokens, jnp.int32), z, z
 
     def check_pipelined_dispatch(self, reseed: bool,
-                                 positions=None) -> None:
+                                 positions=None,
+                                 g_states=None) -> None:
         """Raise every host-side error a pipelined dispatch would, WITHOUT
         dispatching: pod roots call this before broadcasting the control
         packet so a bad call dies on the root with ZERO packets out — a
@@ -1199,6 +1485,12 @@ class InferenceEngine:
                 "select has no carry to read on a reseed — pass real "
                 "positions for every lane"
             )
+        if reseed and g_states is not None and int(np.min(g_states)) < 0:
+            raise ValueError(
+                "reseed dispatch with a -1 grammar state: the carried-"
+                "state select has no carry to read on a reseed — pass "
+                "real states (0 = unconstrained) for every lane"
+            )
         if len(self._pl_inflight) >= max(1, self.pipeline_depth):
             raise RuntimeError(
                 f"pipeline ring full (depth {self.pipeline_depth}): consume "
@@ -1211,7 +1503,7 @@ class InferenceEngine:
             )
 
     def check_fused_dispatch(self, chunk, p_start: int, reseed: bool,
-                             positions=None) -> None:
+                             positions=None, g_states=None) -> None:
         """``check_pipelined_dispatch`` plus the prompt-chunk bounds the
         fused prefill half enforces — the full pre-broadcast validation
         set for OP_DECODE_PREFILL_FUSED."""
@@ -1226,7 +1518,7 @@ class InferenceEngine:
                 f"chunk of {len(chunk)} tokens at pos {p_start} exceeds "
                 f"seq_len {self.config.seq_len}"
             )
-        self.check_pipelined_dispatch(reseed, positions)
+        self.check_pipelined_dispatch(reseed, positions, g_states)
 
     def decode_prefill_fused(
         self,
@@ -1241,6 +1533,8 @@ class InferenceEngine:
         p_topp: float = DEFAULT_TOPP,
         p_seed: int = 0,
         tokens: np.ndarray | None = None,
+        g_states: np.ndarray | None = None,
+        p_g: int = 0,
     ) -> None:
         """Dispatch ONE fused prefill+decode step into the pipelined ring:
         every generating lane advances one token (the ``decode_pipelined``
@@ -1271,13 +1565,14 @@ class InferenceEngine:
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
         self.check_fused_dispatch(chunk, p_start, tokens is not None,
-                                  positions)
+                                  positions, g_states)
         faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
-        feed, carry_pos = self._pl_feed(tokens, positions)
+        g_states = self._g_vec(g_states, tokens is not None)
+        feed, carry_pos, carry_g = self._pl_feed(tokens, positions)
         bucket = self.bucket_for(len(chunk))
         padded = np.zeros(bucket, np.int32)
         padded[: len(chunk)] = chunk
-        nxt, new_pos, packed, self.cache = self._decode_prefill_fn(
+        nxt, new_pos, new_g, packed, self.cache = self._decode_prefill_fn(
             self.params,
             self.cache,
             feed,
@@ -1293,9 +1588,14 @@ class InferenceEngine:
             jnp.float32(p_temp),
             jnp.float32(p_topp),
             jnp.uint32(p_seed & 0xFFFFFFFF),
+            self._gtab(),
+            carry_g,
+            jnp.asarray(g_states, jnp.int32),
+            jnp.int32(p_g),
         )
         self._pl_carry = nxt
         self._pl_carry_pos = new_pos
+        self._pl_carry_g = new_g
         self._pl_inflight.append(("tok", packed, time.perf_counter()))
         with self.stats.lock:
             self.stats.pipeline_dispatches += 1
@@ -1359,6 +1659,7 @@ class InferenceEngine:
             self.pipeline_consume()
         self._pl_carry = None
         self._pl_carry_pos = None
+        self._pl_carry_g = None
         if n and count:
             with self.stats.lock:
                 self.stats.pipeline_flushes += 1
@@ -1380,6 +1681,7 @@ class InferenceEngine:
         self._pl_inflight.clear()
         self._pl_carry = None
         self._pl_carry_pos = None
+        self._pl_carry_g = None
         if n:
             with self.stats.lock:
                 self.stats.pipeline_flushes += 1
@@ -1400,6 +1702,7 @@ class InferenceEngine:
         temps: np.ndarray | None = None,
         topps: np.ndarray | None = None,
         seeds: np.ndarray | None = None,
+        g_states: np.ndarray | None = None,
     ):
         """One speculative decode step for all lanes: verifies each lane's
         next token plus up to SPEC_DRAFT drafted continuations in a single
@@ -1420,6 +1723,8 @@ class InferenceEngine:
             topps = np.full(n, DEFAULT_TOPP, np.float32)
         if seeds is None:
             seeds = np.zeros(n, np.uint32)
+        if g_states is None:
+            g_states = np.zeros(n, np.int32)
         faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
         t0 = time.perf_counter()
         logits, packed_out, self.cache = self._decode_spec_fn(
@@ -1432,6 +1737,8 @@ class InferenceEngine:
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(topps, jnp.float32),
             jnp.asarray(seeds, jnp.uint32),
+            self._gtab(),
+            jnp.asarray(g_states, jnp.int32),
         )
         # dlint: ok[host-sync] the ONE [n, K+1] int32 readback per speculative verify step, counted below
         out_np = np.asarray(packed_out)
@@ -1463,12 +1770,13 @@ class InferenceEngine:
             )
 
     def check_spec_pipelined_dispatch(self, drafts, reseed: bool,
-                                      positions=None) -> None:
+                                      positions=None,
+                                      g_states=None) -> None:
         """``check_pipelined_dispatch`` plus the draft-shape contract —
         the full pre-broadcast validation set for OP_DECODE_SPEC_PIPELINED
         (a packet whose root-side compute raises desyncs the pod)."""
         self.check_spec_drafts(drafts)
-        self.check_pipelined_dispatch(reseed, positions)
+        self.check_pipelined_dispatch(reseed, positions, g_states)
 
     def decode_spec_pipelined(
         self,
@@ -1479,6 +1787,7 @@ class InferenceEngine:
         topps: np.ndarray | None = None,
         seeds: np.ndarray | None = None,
         tokens: np.ndarray | None = None,
+        g_states: np.ndarray | None = None,
     ) -> None:
         """Dispatch ONE speculative verify step INTO the pipelined ring —
         the zero-flush composition of ``decode_spec`` and
@@ -1509,10 +1818,11 @@ class InferenceEngine:
         # drafts arrive as a host ndarray from the scheduler's n-gram probe
         # (or the worker's packet slot view); shape-checked, never synced
         self.check_spec_pipelined_dispatch(drafts, tokens is not None,
-                                           positions)
+                                           positions, g_states)
         faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
-        feed, carry_pos = self._pl_feed(tokens, positions)
-        nxt, new_pos, packed, self.cache = self._decode_spec_pl_fn(
+        g_states = self._g_vec(g_states, tokens is not None)
+        feed, carry_pos, carry_g = self._pl_feed(tokens, positions)
+        nxt, new_pos, new_g, packed, self.cache = self._decode_spec_pl_fn(
             self.params,
             self.cache,
             feed,
@@ -1523,9 +1833,13 @@ class InferenceEngine:
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(topps, jnp.float32),
             jnp.asarray(seeds, jnp.uint32),
+            self._gtab(),
+            carry_g,
+            jnp.asarray(g_states, jnp.int32),
         )
         self._pl_carry = nxt
         self._pl_carry_pos = new_pos
+        self._pl_carry_g = new_g
         self._pl_inflight.append(("spec", packed, time.perf_counter()))
         with self.stats.lock:
             self.stats.pipeline_dispatches += 1
@@ -1552,6 +1866,8 @@ class InferenceEngine:
         p_topp: float = DEFAULT_TOPP,
         p_seed: int = 0,
         tokens: np.ndarray | None = None,
+        g_states: np.ndarray | None = None,
+        p_g: int = 0,
     ) -> None:
         """``decode_spec_pipelined`` that ALSO consumes one bounded prompt
         chunk for lane ``p_lane`` — the full zero-flush composition: an
@@ -1572,33 +1888,41 @@ class InferenceEngine:
         # host ndarray from the probe/packet — shape-checked, never synced
         self.check_spec_drafts(drafts)
         self.check_fused_dispatch(chunk, p_start, tokens is not None,
-                                  positions)
+                                  positions, g_states)
         faults.fire("engine.dispatch")  # chaos harness; no-op unarmed
-        feed, carry_pos = self._pl_feed(tokens, positions)
+        g_states = self._g_vec(g_states, tokens is not None)
+        feed, carry_pos, carry_g = self._pl_feed(tokens, positions)
         bucket = self.bucket_for(len(chunk))
         padded = np.zeros(bucket, np.int32)
         padded[: len(chunk)] = chunk
-        nxt, new_pos, packed, self.cache = self._decode_spec_prefill_fn(
-            self.params,
-            self.cache,
-            feed,
-            carry_pos,
-            jnp.asarray(positions, jnp.int32),
-            jnp.asarray(drafts, jnp.int32),
-            jnp.asarray(draft_len, jnp.int32),
-            jnp.asarray(temps, jnp.float32),
-            jnp.asarray(topps, jnp.float32),
-            jnp.asarray(seeds, jnp.uint32),
-            jnp.int32(p_lane),
-            jnp.asarray(padded),
-            jnp.int32(p_start),
-            jnp.int32(len(chunk)),
-            jnp.float32(p_temp),
-            jnp.float32(p_topp),
-            jnp.uint32(p_seed & 0xFFFFFFFF),
+        nxt, new_pos, new_g, packed, self.cache = (
+            self._decode_spec_prefill_fn(
+                self.params,
+                self.cache,
+                feed,
+                carry_pos,
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(drafts, jnp.int32),
+                jnp.asarray(draft_len, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(topps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32),
+                jnp.int32(p_lane),
+                jnp.asarray(padded),
+                jnp.int32(p_start),
+                jnp.int32(len(chunk)),
+                jnp.float32(p_temp),
+                jnp.float32(p_topp),
+                jnp.uint32(p_seed & 0xFFFFFFFF),
+                self._gtab(),
+                carry_g,
+                jnp.asarray(g_states, jnp.int32),
+                jnp.int32(p_g),
+            )
         )
         self._pl_carry = nxt
         self._pl_carry_pos = new_pos
+        self._pl_carry_g = new_g
         self._pl_inflight.append(("spec", packed, time.perf_counter()))
         with self.stats.lock:
             self.stats.pipeline_dispatches += 1
@@ -1652,6 +1976,8 @@ class InferenceEngine:
             jnp.asarray(zf),
             jnp.asarray(zf),
             jnp.asarray(z.astype(np.uint32)),
+            self._gtab(),
+            jnp.asarray(z),
         ).compile()
         stats = collective_stats_of_compiled(compiled)
         # keep the executable for dispatch: decode shapes never change, so

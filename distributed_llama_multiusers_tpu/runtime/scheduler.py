@@ -152,6 +152,13 @@ class Request:
     # per-request deadline overrides (serving/deadlines.py); None = policy
     queue_timeout_s: float | None = None
     budget_s: float | None = None
+    # structured output (grammar/): the request's response_format —
+    # {"type": "json_object"} or {"type": "json_schema", ...} — compiled
+    # into a token-level automaton at admission and enforced on device;
+    # None = unconstrained. Journaled (and carried by fleet migration
+    # tickets) so replay rebuilds the identical automaton from
+    # (prompt, seed, schema).
+    response_format: dict | None = None
     # crash-durable serving (serving/journal.py): which API route built
     # this request ("chat" | "completion" | None) — journaled so a
     # recovered stream renders the right SSE chunk shape on reattach —
@@ -249,6 +256,14 @@ class _Lane:
     # speculation state: committed (prompt + consumed) token history with
     # an O(1) prompt-lookup draft probe (runtime/spec.py)
     drafter: NgramDraftIndex = field(default_factory=NgramDraftIndex)
+    # grammar-constrained decoding (grammar/): the attached slab handle
+    # (None = unconstrained) and the HOST MIRROR of the lane's automaton
+    # state — absolute slab id, advanced by every emitted token the host
+    # consumes. Exact on the sync paths; one step behind on the
+    # pipelined chain (where the device carry is authoritative and the
+    # mirror only steers draft pre-filtering).
+    grammar: object = None
+    g_state: int = 0
 
 
 # Historical routing boundary, kept for the sampler-parity test grid and
@@ -592,6 +607,7 @@ class ContinuousBatchingScheduler:
             priority=entry.priority,
             queue_timeout_s=entry.queue_timeout_s,
             budget_s=entry.budget_s,
+            response_format=entry.response_format,
             api_kind=entry.kind,
             recovered=True,
             id=entry.request_id,
@@ -638,6 +654,43 @@ class ContinuousBatchingScheduler:
         if getattr(self.engine, "kvpool", None) is not None:
             self.engine.paged_finish(lane_idx, park=park)
 
+    def _grammar_release(self, lane: _Lane) -> None:
+        """Detach a lane's grammar at request end (the tables PARK in the
+        slab for the next same-schema admission). Never raises —
+        containment paths call this too."""
+        if lane.grammar is not None:
+            try:
+                self.engine.grammar_detach(lane.grammar.key)
+            except Exception:  # noqa: BLE001 — release must not throw
+                pass
+
+    def _g_adv(self, lane: _Lane, tok: int) -> None:
+        """Advance a constrained lane's HOST automaton mirror by one
+        emitted token — called exactly once per NEW emitted token, so the
+        mirror equals the device carry on the sync paths and trails it by
+        the ring lag on the pipelined chain (where it only steers draft
+        pre-filtering; the device state is authoritative)."""
+        if lane.grammar is not None:
+            lane.g_state = lane.grammar.next_state(lane.g_state, tok)
+
+    def _g_states_sync(self, active) -> tuple[np.ndarray | None, bool]:
+        """(per-lane grammar-state vector, any-constrained flag) for a
+        synchronous dispatch: the host mirror is exact here. None when no
+        lane is constrained — the engine defaults to all-FREE."""
+        constrained = [
+            (i, l) for i, l in active if l.grammar is not None
+        ]
+        if not constrained:
+            return None, False
+        gs = np.zeros(self.engine.n_lanes, np.int32)
+        for i, lane in constrained:
+            gs[i] = lane.g_state
+        return gs, True
+
+    def _count_masked_step(self) -> None:
+        with self.engine.stats.lock:
+            self.engine.stats.grammar_masked_steps += 1
+
     def occupancy(self) -> tuple[int, int]:
         """(busy lanes, total lanes) — public surface for /stats."""
         return (
@@ -677,6 +730,11 @@ class ContinuousBatchingScheduler:
         pool = getattr(self.engine, "pool_stats", None)
         if callable(pool):
             out.update(pool())
+        # grammar slab pressure (schemas installed/live, state occupancy):
+        # bridged to /metrics as dllama_stats_* gauges like every field
+        gram = getattr(self.engine, "grammar_stats", None)
+        if callable(gram):
+            out.update(gram())
         return out
 
     def _on_watchdog_trip(self, waited_s: float) -> None:
@@ -732,6 +790,7 @@ class ContinuousBatchingScheduler:
         req.finish_reason = "error"
         # failed contents are final: the session can no longer migrate
         self._session_records.pop(req.id, None)
+        self._grammar_release(self._lanes[lane_idx])
         self._lanes[lane_idx] = _Lane()
         self._lane_kv[lane_idx] = []
         try:
@@ -918,11 +977,35 @@ class ContinuousBatchingScheduler:
         ) & 0xFFFFFFFF
         # the on-device sampler is full-vocab exact, so host-exact survives
         # only as the host_sampling=True escape hatch (bit-exact reference
-        # xorshift streams); wide-nucleus/high-temp requests stay on device
-        lane.host_exact = self.host_sampling
+        # xorshift streams); wide-nucleus/high-temp requests stay on device.
+        # Constrained requests stay on device UNCONDITIONALLY: the grammar
+        # mask lives inside the compiled step, and a host xorshift draw
+        # over unmasked logits could emit an illegal token.
+        lane.host_exact = self.host_sampling and req.response_format is None
         if lane.host_exact and req.temperature > 0.0:
             with self.engine.stats.lock:
                 self.engine.stats.host_exact_lanes += 1
+        # structured output (grammar/): compile + attach the automaton
+        # BEFORE the admit record, so a schema that fails to compile
+        # fails the request with no journal entry to resurrect. The
+        # ValueError family (GrammarError, unsupported engine) is
+        # request-scoped -> HTTP 400; a slab exhausted by live schemas
+        # sheds retryably like the paged pool.
+        if req.response_format is not None:
+            from ..grammar.slab import GrammarSlabFull
+
+            try:
+                lane.grammar = self.engine.grammar_attach(
+                    req.response_format
+                )
+            except GrammarSlabFull as e:
+                note = getattr(self.queue, "note_rejection", None)
+                if note is not None:
+                    note("grammar_slab_full")
+                raise AdmissionRejected(
+                    "grammar_slab_full", retry_after_s=1.0
+                ) from e
+            lane.g_state = lane.grammar.start_state
         lane.sampler = Sampler(
             self.engine.config.vocab_size, req.temperature, req.topp, lane.seed
         )
@@ -947,6 +1030,7 @@ class ContinuousBatchingScheduler:
             user=req.user_id, priority=int(req.priority),
             queue_timeout_s=req.queue_timeout_s, budget_s=req.budget_s,
             stream=req.on_delta is not None, kind=req.api_kind,
+            response_format=req.response_format,
         )
         self._session_records[req.id] = (admit_record(**admit_kw), req)
         if self.journal is not None:
@@ -979,6 +1063,9 @@ class ContinuousBatchingScheduler:
                 lane_idx, chunk, lane.pos,
                 temp=0.0 if lane.host_exact else req.temperature,
                 topp=req.topp, seed=lane.seed,
+                # boundary token (the first generated one, on the final
+                # chunk) samples under the automaton's start-state mask
+                g_state=lane.g_state,
             )
         except Exception as e:
             # request-scoped (chunk validation, the ValueError family):
@@ -1010,6 +1097,7 @@ class ContinuousBatchingScheduler:
         else:
             first = int(sampled)  # sampled inside the compiled prefill step
         lane.next_token = first
+        self._g_adv(lane, first)
         req.state = RequestState.GENERATING
         return True
 
@@ -1266,11 +1354,24 @@ class ContinuousBatchingScheduler:
         temps = np.zeros(n_lanes, np.float32)
         topps = np.full(n_lanes, DEFAULT_TOPP, np.float32)
         seeds = np.zeros(n_lanes, np.uint32)
+        # grammar states ride the dispatch like positions: -1 = the
+        # device carry (authoritative in-chain), host mirror on a reseed
+        # (ring empty: the mirror is exact), 0 = FREE for idle/admitting
+        # lanes (an admitting lane's constraint enters via p_g below)
+        g_any = any(
+            l.grammar is not None
+            for l in (*live.values(), *admitting.values())
+        )
+        gs = np.zeros(n_lanes, np.int32) if g_any else None
         for i, lane in live.items():
             positions[i] = min(lane.pos, seq_len) if reseed else -1
+            if gs is not None:
+                gs[i] = lane.g_state if reseed else -1
             temps[i] = lane.request.temperature
             topps[i] = lane.request.topp
             seeds[i] = lane.seed
+        if g_any:
+            self._count_masked_step()
         # draft probe (host-side n-gram lookup over committed history +
         # the last known fed token; legal here by construction — dlint's
         # pipeline-sync pins that nothing below syncs a device value)
@@ -1291,11 +1392,26 @@ class ContinuousBatchingScheduler:
                     # ring empty: nt IS this dispatch's feed — ship it as
                     # candidate 0 (the carry gate passes trivially)
                     d = [nt] + lane.drafter.draft(nt, spec_k)
+                    if lane.grammar is not None and len(d) > 1:
+                        # pre-filter through the host mirror (exact here:
+                        # nt is already counted in it) — a draft the mask
+                        # would reject is simply not proposed
+                        d = d[: 1 + lane.grammar.filter_prefix(
+                            lane.g_state, d[1:]
+                        )]
                 else:
                     # one step behind: nt fed the in-flight step; its
                     # output is the carry, so the probe's first
                     # continuation IS the carry candidate
                     d = lane.drafter.draft(nt, spec_k + 1)
+                    if lane.grammar is not None and d:
+                        # mirror trails the device by the in-flight step;
+                        # filtering from it is approximate — harmless
+                        # (device verification is exact), it only avoids
+                        # shipping obviously illegal candidates
+                        d = d[: lane.grammar.filter_prefix(
+                            lane.g_state, d
+                        )]
                 if len(d) >= 2:  # candidate 0 alone cannot accept anything
                     if drafts is None:
                         drafts = np.zeros((n_lanes, spec_k + 1), np.int32)
@@ -1314,23 +1430,27 @@ class ContinuousBatchingScheduler:
         if target is None:
             if drafts is None:
                 engine.decode_pipelined(positions, temps, topps, seeds,
-                                        tokens=feed)
+                                        tokens=feed, g_states=gs)
                 return None, None
             engine.decode_spec_pipelined(
                 positions, drafts, draft_len, temps, topps, seeds,
-                tokens=feed,
+                tokens=feed, g_states=gs,
             )
             return None, drafted
         lane = admitting[target]
         req = lane.request
         chunk = lane.pending[: engine.max_chunk()]
+        # the admitting lane's boundary token samples under its
+        # automaton's START state (== lane.g_state until its first
+        # emission); junk for mid-prompt chunks, decisive on the final one
+        p_g = lane.g_state if lane.grammar is not None else 0
         if drafts is None:
             engine.decode_prefill_fused(
                 positions, temps, topps, seeds,
                 p_lane=target, chunk=chunk, p_start=lane.pos,
                 p_temp=0.0 if lane.host_exact else req.temperature,
                 p_topp=req.topp, p_seed=lane.seed,
-                tokens=feed,
+                tokens=feed, g_states=gs, p_g=p_g,
             )
         else:
             # the full composition: an admitting chunk and a spec verify
@@ -1340,7 +1460,7 @@ class ContinuousBatchingScheduler:
                 p_lane=target, chunk=chunk, p_start=lane.pos,
                 p_temp=0.0 if lane.host_exact else req.temperature,
                 p_topp=req.topp, p_seed=lane.seed,
-                tokens=feed,
+                tokens=feed, g_states=gs, p_g=p_g,
             )
         lane.pos += len(chunk)
         lane.pending = lane.pending[len(chunk):]
@@ -1417,6 +1537,10 @@ class ContinuousBatchingScheduler:
                 # the model's token after the accepted prefix becomes the
                 # new pending token — the sync spec path's rule verbatim
                 cnt = int(n_emit[i])
+                if lane.grammar is not None:
+                    # catch the host mirror up by the whole lagged window
+                    for t in emitted[i, :cnt]:
+                        self._g_adv(lane, int(t))
                 seq = [lane.next_token] + [
                     int(t) for t in emitted[i, : cnt - 1]
                 ]
@@ -1450,6 +1574,7 @@ class ContinuousBatchingScheduler:
                 lane.next_token = int(greedy_np[i])
             else:
                 lane.next_token = int(sampled_np[i])
+            self._g_adv(lane, lane.next_token)
         if fused is not None:
             i, lane, final, _n_chunk = fused
             if final and live.get(i) is lane:
@@ -1471,6 +1596,8 @@ class ContinuousBatchingScheduler:
                 lane.next_token = (
                     b_greedy if req.temperature == 0.0 else b_sampled
                 )
+                # mirror: start state advanced by the boundary emission
+                self._g_adv(lane, lane.next_token)
                 req.state = RequestState.GENERATING
 
     def _run_pipelined(self, active) -> None:
@@ -1638,6 +1765,7 @@ class ContinuousBatchingScheduler:
             req.generated_text += delta
             if req.on_delta:
                 req.on_delta(delta)
+        self._grammar_release(self._lanes[lane_idx])
         self._lanes[lane_idx] = _Lane()
         # paged: the finished session PARKS — its tree-registered blocks
         # stay resident (refcounted, LRU-bounded) so chat follow-ups and
@@ -1915,6 +2043,14 @@ class ContinuousBatchingScheduler:
                     d_max = min(spec_k, cfg.seq_len - lane.pos - 1)
                     if lane.request.temperature == 0.0 and d_max > 0:
                         d = lane.drafter.draft(lane.next_token, spec_k)[:d_max]
+                        if lane.grammar is not None and d:
+                            # host pre-filter: a draft the mask would
+                            # reject is simply not proposed (the sync
+                            # mirror is exact here), so verification
+                            # stays the model's own masked-greedy path
+                            d = d[: lane.grammar.filter_prefix(
+                                lane.g_state, d
+                            )]
                         drafts[i, : len(d)] = d
                         draft_len[i] = len(d)
                 if not draft_len.any():
@@ -1933,6 +2069,11 @@ class ContinuousBatchingScheduler:
             h = 0 if draft_len is not None else self._multi_horizon(
                 active, prefilled
             )
+            # grammar states for this dispatch (exact host mirror on the
+            # sync paths); None -> the engine's all-FREE default
+            g_states, g_any = self._g_states_sync(active)
+            if g_any:
+                self._count_masked_step()
             wd = self.watchdog
             if wd is not None:
                 wd.begin_step()
@@ -1941,12 +2082,13 @@ class ContinuousBatchingScheduler:
                 if draft_len is not None:
                     logits, emitted, n_emit = self.engine.decode_spec(
                         tokens, drafts, draft_len, positions, temps, topps,
-                        seeds
+                        seeds, g_states=g_states,
                     )
                 elif h > 1:
                     logits = None  # host-exact lanes are excluded by the gate
                     chosen = self.engine.decode_multi(
-                        tokens, positions, temps, topps, seeds, h
+                        tokens, positions, temps, topps, seeds, h,
+                        g_states=g_states,
                     )
                 else:
                     # logits materialize only when a host-exact lane will
@@ -1955,6 +2097,7 @@ class ContinuousBatchingScheduler:
                     logits, greedy, sampled = self.engine.decode(
                         tokens, positions, temps, topps, seeds,
                         want_logits=host_exact_active,
+                        g_states=g_states,
                     )
                 self.telemetry.on_step(
                     "spec" if draft_len is not None
@@ -1989,6 +2132,11 @@ class ContinuousBatchingScheduler:
                     # but always emit 1, which would dilute the metric
                     drafted = int(draft_len[i]) > 0
                     cnt = int(n_emit[i])
+                    if lane.grammar is not None:
+                        # every emitted token is a NEW emission: the last
+                        # becomes next_token, the rest are consumed below
+                        for t in emitted[i, :cnt]:
+                            self._g_adv(lane, int(t))
                     seq = [lane.next_token] + [
                         int(t) for t in emitted[i, : cnt - 1]
                     ]
@@ -2012,6 +2160,9 @@ class ContinuousBatchingScheduler:
                     # h-1 chained choices; the last choice becomes the new
                     # pending token. Tokens past a stop are discarded (their
                     # junk KV is rewritten before any query reads it).
+                    if lane.grammar is not None:
+                        for j in range(h):  # h new emissions this horizon
+                            self._g_adv(lane, int(chosen[j, i]))
                     seq = [lane.next_token] + [
                         int(chosen[j, i]) for j in range(h - 1)
                     ]
@@ -2035,3 +2186,8 @@ class ContinuousBatchingScheduler:
                     lane.next_token = lane.sampler.sample(logits_np[i])
                 else:
                     lane.next_token = nxt_sampled
+                if draft_len is None:
+                    # plain step: ONE new emission (the spec branch
+                    # advanced its whole window above; multi continues
+                    # before reaching here)
+                    self._g_adv(lane, lane.next_token)

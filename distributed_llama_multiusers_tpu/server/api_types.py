@@ -55,6 +55,11 @@ class InferenceParams:
     seed: int | None = None
     stop: list[str] = field(default_factory=list)
     stream: bool = False
+    # structured output (grammar/; docs/SERVING.md "Structured output"):
+    # {"type": "json_object"} or {"type": "json_schema", ...}; validated
+    # structurally at parse time so malformed schemas 400 before any
+    # admission work
+    response_format: dict | None = None
     # QoS identity (serving/qos.py): "user" is the OpenAI API's own
     # end-user field and keys the per-user fair share; "priority" is
     # "high" | "normal" | "low" (or the int class value)
@@ -78,6 +83,14 @@ class InferenceParams:
         elif isinstance(stop, list):
             p.stop = [str(s) for s in stop]
         p.stream = bool(body.get("stream", False))
+        if body.get("response_format") is not None:
+            # GrammarError is a ValueError -> the route's typed 400; the
+            # canonical form ships onward so journal/migration records
+            # are stable regardless of client-side field ordering
+            from ..grammar.automaton import validate_response_format
+
+            validate_response_format(body["response_format"])
+            p.response_format = dict(body["response_format"])
         if body.get("user") is not None:
             p.user = str(body.get("user", ""))
         if body.get("priority") is not None:

@@ -128,6 +128,7 @@ class ApiServer:
             stop=params.stop,
             user_id=params.user,
             priority=params.priority,
+            response_format=params.response_format,
             api_kind=kind,
         )
         relay = None
@@ -379,6 +380,12 @@ class ApiServer:
             },
             "prefix_hits": stats["prefix_hits"],
             "prefix_tokens_saved": stats["prefix_tokens_saved"],
+            # grammar-constrained decoding (grammar/): admissions that
+            # attached a compiled automaton and dispatches that carried
+            # at least one constrained lane; the slab-pressure gauges
+            # (schemas installed/live, state occupancy) ride qos_stats
+            "grammar_lanes": stats["grammar_lanes"],
+            "grammar_masked_steps": stats["grammar_masked_steps"],
             # failure containment (multihost.worker_serve): supervised
             # restarts + classified protocol errors on THIS process —
             # non-zero only on pod processes that actually restarted

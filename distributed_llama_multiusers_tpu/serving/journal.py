@@ -67,7 +67,8 @@ def admit_record(*, request_id: int, prompt: str, tokens: list[int],
                  seed: int, stop: list[str], add_bos: bool,
                  add_special_tokens: bool, user: str | None, priority: int,
                  queue_timeout_s: float | None, budget_s: float | None,
-                 stream: bool, kind: str | None = None) -> dict:
+                 stream: bool, kind: str | None = None,
+                 response_format: dict | None = None) -> dict:
     """THE admit wire record — one field-mapping site shared by
     :meth:`RequestJournal.record_admit` (the on-disk journal) and the
     scheduler's live-session mirror (``export_session``, the fleet
@@ -87,6 +88,12 @@ def admit_record(*, request_id: int, prompt: str, tokens: list[int],
         "user": None if user is None else str(user),
         "prio": int(priority), "queue_timeout_s": queue_timeout_s,
         "budget_s": budget_s, "stream": bool(stream), "kind": kind,
+        # structured output (grammar/): the response_format the automaton
+        # recompiles from on replay/migration — with the journaled seed it
+        # makes a constrained stream deterministic from (prompt, seed,
+        # schema). None for unconstrained requests (old journals decode
+        # with the same default).
+        "response_format": response_format,
     }
 
 
@@ -140,6 +147,7 @@ class JournalEntry:
     budget_s: float | None = None
     stream: bool = False
     kind: str | None = None  # "chat" | "completion" | None (CLI/bench)
+    response_format: dict | None = None  # structured output (grammar/)
     watermark: int = 0  # tokens already delivered to the client transport
     finished: bool = False
     finish_reason: str | None = None
@@ -183,6 +191,11 @@ class JournalImage:
                 budget_s=rec.get("budget_s"),
                 stream=bool(rec.get("stream", False)),
                 kind=rec.get("kind"),
+                response_format=(
+                    dict(rec["response_format"])
+                    if isinstance(rec.get("response_format"), dict)
+                    else None
+                ),
             )
             if prev is not None:
                 # a recovered request re-journals on re-admission: its
@@ -341,7 +354,8 @@ class RequestJournal:
                      add_special_tokens: bool, user: str | None,
                      priority: int,
                      queue_timeout_s: float | None, budget_s: float | None,
-                     stream: bool, kind: str | None = None) -> None:
+                     stream: bool, kind: str | None = None,
+                     response_format: dict | None = None) -> None:
         """One admitted request, with the RESOLVED seed — everything a
         deterministic replay needs to regenerate the identical stream."""
         with self._lock:
@@ -357,6 +371,7 @@ class RequestJournal:
             add_special_tokens=add_special_tokens, user=user,
             priority=priority, queue_timeout_s=queue_timeout_s,
             budget_s=budget_s, stream=stream, kind=kind,
+            response_format=response_format,
         ))
 
     def note_progress(self, request_id: int, tokens_delivered: int) -> None:

@@ -82,6 +82,41 @@ class StubStreamTokenizer:
         return "x"
 
 
+class ByteJsonTokenizer(StubStreamTokenizer):
+    """Byte-level tokenizer for grammar-constrained harnesses: id 0 =
+    BOS (special), ids 1..256 = the raw bytes 0..255, id 257 = EOS —
+    every byte is a token, so the grammar automaton's token closure is
+    the character machine itself and constrained mock streams decode to
+    REAL text the tests can ``json.loads``. ``token_table()`` feeds
+    ``engine.grammar_init`` (None for the specials, bytes elsewhere)."""
+
+    def __init__(self):
+        super().__init__(vocab_size=258)
+        self.eos_token_ids = [257]
+        self.bos_id = 0
+        # a recognizable template marker so ApiServer's chat route works
+        # against this tokenizer (rendered text is plain bytes anyway)
+        self.chat_template = "[INST]"
+
+    def token_table(self):
+        return [None] + [bytes([i]) for i in range(256)] + [None]
+
+    def encode(self, text, add_bos=True, add_special_tokens=True):
+        data = text.encode("utf-8", errors="replace") or b"?"
+        out = [0] if add_bos else []
+        return out + [1 + b for b in data]
+
+    def decode(self, token):  # stream-decoder protocol
+        # BOS/EOS yield nothing; ids past the byte range (model vocab
+        # padding an UNCONSTRAINED lane can sample) render as nothing
+        # too — only grammar-masked lanes are guaranteed in-range
+        if not 1 <= int(token) <= 256:
+            return None
+        # latin-1 keeps the byte value verbatim, so the concatenated
+        # stream text reconstructs the constrained byte stream exactly
+        return bytes([int(token) - 1]).decode("latin-1")
+
+
 class CharStreamTokenizer(StubStreamTokenizer):
     """Char-level, prompt-DEPENDENT encoding for prefix-sharing
     harnesses: shared text prefixes become shared token prefixes exactly
@@ -193,9 +228,17 @@ class MockAsyncEngine:
         self._carry_live = False
         # simulated device carry: each lane's next feed token + write
         # position (the real engine's _pl_carry/_pl_carry_pos); a host
-        # position >= 0 overrides, -1 reads the carry — same contract
+        # position >= 0 overrides, -1 reads the carry — same contract.
+        # _sim_g is the grammar-state carry (absolute slab id, 0 = FREE)
         self._sim_tok = np.zeros(n_lanes, np.int64)
         self._sim_pos = np.zeros(n_lanes, np.int64)
+        self._sim_g = np.zeros(n_lanes, np.int64)
+        # grammar-constrained decoding: the REAL slab + compiler (pure
+        # numpy — no jax needed); the mocked device half is the masked
+        # token choice in _tok_g
+        self.grammar_slab = None
+        self._g_vocab = None
+        self._g_eos = ()
         self._steps = 0
         self.events = []  # ("dispatch"|"consume", step_idx)
         # paged KV mirror (the real engine's host half, device half mocked)
@@ -217,6 +260,95 @@ class MockAsyncEngine:
 
     def max_chunk(self):
         return self._max_chunk
+
+    # -- grammar-constrained decoding (grammar/; REAL slab + compiler) -----
+
+    @property
+    def supports_grammar(self):
+        return self._g_vocab is not None
+
+    def grammar_init(self, token_table, eos_ids):
+        from ..grammar.slab import GrammarSlab
+
+        table = list(token_table)[: self.config.vocab_size]
+        table += [None] * (self.config.vocab_size - len(table))
+        self._g_vocab = table
+        self._g_eos = tuple(int(e) for e in eos_ids)
+        self.grammar_slab = GrammarSlab(self.config.vocab_size)
+
+    def grammar_attach(self, rf):
+        if self._g_vocab is None:
+            raise ValueError(
+                "structured output is disabled on this engine "
+                "(--grammar off, or no tokenizer vocab registered)"
+            )
+        from ..grammar.automaton import compile_automaton
+
+        auto = compile_automaton(rf, self._g_vocab, self._g_eos)
+        handle = self.grammar_slab.attach(auto)
+        with self.stats.lock:
+            self.stats.grammar_lanes += 1
+        return handle
+
+    def grammar_detach(self, key):
+        self.grammar_slab.detach(key)
+
+    def grammar_stats(self):
+        return (
+            self.grammar_slab.stats() if self.grammar_slab is not None
+            else {}
+        )
+
+    def _g_next_abs(self, g, tok):
+        """Absolute-state transition (the device rule's mock twin)."""
+        if g <= 0 or self.grammar_slab is None:
+            return 0
+        got = self.grammar_slab.resolve(int(g))
+        if got is None:
+            return 0
+        auto, base = got
+        return base + auto.next_state(int(g) - base, int(tok))
+
+    def _tok_g(self, lane, pos, g):
+        """The masked token choice: the deterministic base token function
+        picks WHICH legal token (mod the legal count), so constrained
+        streams stay pure functions of (content key, position) — the
+        replay-determinism class — while always being grammar-legal
+        (the real engine's masked-argmax analogue)."""
+        t = self._tok(lane, pos)
+        if g is None or g <= 0 or self.grammar_slab is None:
+            return t
+        got = self.grammar_slab.resolve(int(g))
+        if got is None:
+            return t
+        auto, base = got
+        legal = auto.legal_ids(int(g) - base)
+        # choose among the LOWEST legal ids (structural bytes sort low):
+        # a real model's masked argmax terminates values promptly; an
+        # unbiased pick over ~250 legal string bytes would close a quote
+        # once per ~250 tokens and every mock stream would hit max_tokens.
+        # The index mixes (t, pos) NON-linearly: the raw token function is
+        # linear in pos mod 256, and a linear pick resonates with
+        # multi-token loop bodies (an array that never draws ']' runs to
+        # max_tokens deterministically).
+        cap = min(len(legal), 12)
+        h = (t * 2654435761 + int(pos) * 0x9E3779B1) & 0xFFFFFFFF
+        h ^= h >> 13
+        return int(legal[h % cap])
+
+    def _eff_g(self, g_states, reseed=False):
+        """The grammar-state select: None defaults like the real engine
+        (FREE on reseed, carry otherwise); -1 reads the simulated carry,
+        >= 0 overrides."""
+        n = self.n_lanes
+        if g_states is None:
+            if reseed:
+                return [0] * n
+            return [int(x) for x in self._sim_g]
+        return [
+            int(self._sim_g[i]) if int(g) < 0 else int(g)
+            for i, g in enumerate(g_states)
+        ]
 
     # -- paged KV (runtime/kvpool.py contract; device half mocked) ---------
 
@@ -279,26 +411,35 @@ class MockAsyncEngine:
             k = (k * 1000003 + int(t) + 1) & 0xFFFFFFFF
         self._lane_key[int(lane)] = k
 
-    def prefill_chunk(self, lane, chunk, start_pos, temp=0.0, topp=0.9, seed=0):
+    def prefill_chunk(self, lane, chunk, start_pos, temp=0.0, topp=0.9,
+                      seed=0, g_state=0):
         from . import faults
 
         faults.fire("engine.dispatch")
         self._feed_key(lane, chunk, start_pos)
-        t = self._tok(lane, start_pos + len(chunk) - 1)
+        # boundary token under the automaton's start-state mask (the
+        # real engine's _prefill_half rule; g_state 0 = identity)
+        t = self._tok_g(lane, start_pos + len(chunk) - 1, g_state)
         with self.stats.lock:
             self.stats.prefill_tokens += len(chunk)
         return None, t, t
 
-    def _toks_at(self, positions):
+    def _toks_at(self, positions, g_states=None):
         import numpy as np
 
         return np.asarray(
-            [self._tok(i, positions[i]) for i in range(self.n_lanes)],
+            [
+                self._tok_g(
+                    i, positions[i],
+                    0 if g_states is None else int(g_states[i]),
+                )
+                for i in range(self.n_lanes)
+            ],
             np.int32,
         )
 
     def decode(self, tokens, positions, temps=None, topps=None, seeds=None,
-               want_logits=True):
+               want_logits=True, g_states=None):
         from . import faults
 
         faults.fire("engine.dispatch")
@@ -309,15 +450,15 @@ class MockAsyncEngine:
         self._steps += 1
         with self.stats.lock:
             self.stats.decode_steps += 1
-        t = self._toks_at(positions)
+        t = self._toks_at(positions, g_states)
         return None, t, t
 
     def decode_spec(self, tokens, drafts, draft_len, positions, temps=None,
-                    topps=None, seeds=None):
+                    topps=None, seeds=None, g_states=None):
         """Synchronous speculative verify over the deterministic token
         function: the real engine's acceptance rule (longest draft prefix
         matching the model's own continuation) with greedy_j =
-        f(lane, pos + j)."""
+        f(lane, pos + j) — masked per position for constrained lanes."""
         import numpy as np
 
         from . import faults
@@ -327,36 +468,48 @@ class MockAsyncEngine:
         self._free_at = max(now, self._free_at) + self.step_s
         time.sleep(max(0.0, self._free_at - now))
         self._steps += 1
-        emitted, n_emit = self._verify(
+        emitted, n_emit, _ = self._verify(
             np.asarray(tokens), np.asarray(drafts), np.asarray(draft_len),
             np.asarray(positions),
+            None if g_states is None else [int(g) for g in g_states],
         )
         with self.stats.lock:
             self.stats.decode_steps += 1
             self.stats.spec_steps += 1
         return None, emitted, n_emit
 
-    def _verify(self, tokens, drafts, draft_len, positions):
+    def _verify(self, tokens, drafts, draft_len, positions, g0=None):
         """The acceptance math shared by the sync and in-chain verify
         mocks. drafts here are the K continuation candidates (the real
-        ``decode_spec`` layout)."""
+        ``decode_spec`` layout). The grammar state walks the window
+        exactly like the real verify core: each position's greedy is the
+        MASKED choice under the state reached by the accepted prefix.
+        Returns (emitted, n_emit, g_final) with g_final the per-lane
+        state after the last emitted token."""
         import numpy as np
 
         n = self.n_lanes
         k = drafts.shape[1]
         emitted = np.zeros((n, k + 1), np.int64)
         n_emit = np.ones(n, np.int64)
+        g_final = np.zeros(n, np.int64)
         seq_len = self.config.seq_len
         for i in range(n):
             pos = int(positions[i])
+            g = 0 if g0 is None else int(g0[i])
             dlen = min(int(draft_len[i]), max(0, seq_len - pos - 1), k)
-            acc = 0
-            while acc < dlen and int(drafts[i, acc]) == self._tok(i, pos + acc):
-                acc += 1
-            n_emit[i] = acc + 1
-            for j in range(acc + 1):
-                emitted[i, j] = self._tok(i, pos + j)
-        return emitted, n_emit
+            j = 0
+            while True:
+                t = self._tok_g(i, pos + j, g)
+                emitted[i, j] = t
+                g = self._g_next_abs(g, t)
+                if j < dlen and int(drafts[i, j]) == t:
+                    j += 1
+                    continue
+                break
+            n_emit[i] = j + 1
+            g_final[i] = g
+        return emitted, n_emit, g_final
 
     def pipeline_inflight(self):
         return len(self._ring)
@@ -389,20 +542,25 @@ class MockAsyncEngine:
             )
 
     def decode_pipelined(self, positions, temps=None, topps=None, seeds=None,
-                         tokens=None):
+                         tokens=None, g_states=None):
         from . import faults
 
         faults.fire("engine.dispatch")
         eff = self._eff_positions(positions)
-        toks = [self._tok(i, eff[i]) for i in range(self.n_lanes)]
+        effg = self._eff_g(g_states, reseed=tokens is not None)
+        toks = [
+            self._tok_g(i, eff[i], effg[i]) for i in range(self.n_lanes)
+        ]
         for i in range(self.n_lanes):
             self._sim_tok[i] = toks[i]
             self._sim_pos[i] = min(eff[i] + 1, self.config.seq_len)
+            self._sim_g[i] = self._g_next_abs(effg[i], toks[i])
         self._push("tok", (toks, None))
 
     def decode_prefill_fused(self, positions, temps=None, topps=None,
                              seeds=None, p_lane=0, chunk=None, p_start=0,
-                             p_temp=0.0, p_topp=0.9, p_seed=0, tokens=None):
+                             p_temp=0.0, p_topp=0.9, p_seed=0, tokens=None,
+                             g_states=None, p_g=0):
         """Fused prefill+decode dispatch: one simulated device step that
         both advances the decode lanes and consumes one prompt chunk; the
         packed readback carries the chunk's boundary token in an extra
@@ -417,15 +575,20 @@ class MockAsyncEngine:
             )
         faults.fire("engine.dispatch")
         eff = self._eff_positions(positions)
-        toks = [self._tok(i, eff[i]) for i in range(self.n_lanes)]
+        effg = self._eff_g(g_states, reseed=tokens is not None)
+        toks = [
+            self._tok_g(i, eff[i], effg[i]) for i in range(self.n_lanes)
+        ]
         self._feed_key(p_lane, chunk, p_start)
-        boundary = self._tok(p_lane, p_start + len(chunk) - 1)
+        boundary = self._tok_g(p_lane, p_start + len(chunk) - 1, p_g)
         for i in range(self.n_lanes):
             self._sim_tok[i] = toks[i]
             self._sim_pos[i] = min(eff[i] + 1, self.config.seq_len)
+            self._sim_g[i] = self._g_next_abs(effg[i], toks[i])
         # the joined lane's carry = the boundary pair (real-engine rule)
         self._sim_tok[p_lane] = boundary
         self._sim_pos[p_lane] = p_start + len(chunk)
+        self._sim_g[p_lane] = self._g_next_abs(p_g, boundary)
         self._push("tok", (toks, boundary))
         with self.stats.lock:
             self.stats.fused_steps += 1
@@ -434,14 +597,16 @@ class MockAsyncEngine:
                 self.stats.fused_bucket_hist.get(self._max_chunk, 0) + 1
             )
 
-    def _spec_payload(self, positions, drafts, draft_len, tokens):
-        """The in-chain verify sim: resolve carry tok/pos, apply the
-        candidate-0 alignment gate, run the acceptance math, and advance
-        the simulated carry by the per-lane emit counts."""
+    def _spec_payload(self, positions, drafts, draft_len, tokens,
+                      g_states=None):
+        """The in-chain verify sim: resolve carry tok/pos/grammar-state,
+        apply the candidate-0 alignment gate, run the acceptance math,
+        and advance the simulated carries by the per-lane emit counts."""
         import numpy as np
 
         n = self.n_lanes
         eff = self._eff_positions(positions)
+        effg = self._eff_g(g_states, reseed=tokens is not None)
         carry = (
             [int(t) for t in tokens] if tokens is not None
             else [int(t) for t in self._sim_tok]
@@ -457,23 +622,24 @@ class MockAsyncEngine:
         for i in range(n):
             if int(draft_len[i]) > 0 and int(drafts[i][0]) == carry[i]:
                 eff_len[i] = int(draft_len[i]) - 1
-        emitted, n_emit = self._verify(
-            np.asarray(carry), eff_drafts, eff_len, np.asarray(eff),
+        emitted, n_emit, g_final = self._verify(
+            np.asarray(carry), eff_drafts, eff_len, np.asarray(eff), effg,
         )
         for i in range(n):
             cnt = int(n_emit[i])
             self._sim_tok[i] = int(emitted[i, cnt - 1])
             self._sim_pos[i] = min(eff[i] + cnt, self.config.seq_len)
+            self._sim_g[i] = int(g_final[i])
         return emitted, n_emit
 
     def decode_spec_pipelined(self, positions, drafts, draft_len,
                               temps=None, topps=None, seeds=None,
-                              tokens=None):
+                              tokens=None, g_states=None):
         from . import faults
 
         faults.fire("engine.dispatch")
         emitted, n_emit = self._spec_payload(
-            positions, drafts, draft_len, tokens
+            positions, drafts, draft_len, tokens, g_states
         )
         self._push("spec", (emitted, n_emit))
         with self.stats.lock:
@@ -484,7 +650,7 @@ class MockAsyncEngine:
                                   temps=None, topps=None, seeds=None,
                                   p_lane=0, chunk=None, p_start=0,
                                   p_temp=0.0, p_topp=0.9, p_seed=0,
-                                  tokens=None):
+                                  tokens=None, g_states=None, p_g=0):
         """An admitting chunk and a spec verify sharing one dispatch —
         the readback appends the boundary pair as an extra ROW
         (emitted[-1, :2]), the real engine's spec-pack layout."""
@@ -500,12 +666,13 @@ class MockAsyncEngine:
             )
         faults.fire("engine.dispatch")
         emitted, n_emit = self._spec_payload(
-            positions, drafts, draft_len, tokens
+            positions, drafts, draft_len, tokens, g_states
         )
         self._feed_key(p_lane, chunk, p_start)
-        boundary = self._tok(p_lane, p_start + len(chunk) - 1)
+        boundary = self._tok_g(p_lane, p_start + len(chunk) - 1, p_g)
         self._sim_tok[p_lane] = boundary
         self._sim_pos[p_lane] = p_start + len(chunk)
+        self._sim_g[p_lane] = self._g_next_abs(p_g, boundary)
         brow = np.zeros((1, emitted.shape[1]), np.int64)
         brow[0, 0] = brow[0, 1] = boundary
         emitted = np.concatenate([emitted, brow])

@@ -1272,6 +1272,48 @@ def test_host_sync_covers_fleet_files(tmp_path):
     assert clean == []
 
 
+def test_host_sync_covers_grammar_files(tmp_path):
+    """ISSUE-13 satellite: the grammar package (schema compiler + slab)
+    is pure-host numpy BY CONTRACT — it rides the admission and dispatch
+    paths, so a device transfer spelling there would serialize every
+    constrained dispatch on the automaton tables. Known-bad fixtures
+    flag; the known-good shape (packbits/searchsorted host math, the
+    real compiler idiom) stays clean; the shipped package keeps an
+    empty baseline (test_package_analyzes_clean is the gate)."""
+    bad = """
+        import numpy as np
+
+        def masks_of(rows):
+            return np.asarray(rows)
+    """
+    for rel in ("grammar/automaton.py", "grammar/slab.py"):
+        findings = run_on(tmp_path / rel.replace("/", "_"), {rel: bad})
+        assert checks_of(findings) == ["host-sync"], rel
+    bad_item = """
+        def next_state(keys, key):
+            return keys.searchsorted(key).item()
+    """
+    findings = run_on(tmp_path / "item", {"grammar/slab.py": bad_item})
+    assert checks_of(findings) == ["host-sync"]
+    # the clean shape: the compiler's real host idiom — packed masks and
+    # sorted sparse edges, no transfer spellings anywhere
+    clean = run_on(tmp_path / "ok", {"grammar/automaton.py": """
+        import numpy as np
+
+        def pack_masks(legal):
+            bits = np.zeros((legal.shape[1], legal.shape[0]), np.uint8)
+            bits[:, : legal.shape[0]] = legal.T
+            return np.packbits(bits, axis=1, bitorder="little")
+
+        def edge_lookup(keys, nexts, default, key):
+            j = int(np.searchsorted(keys, key))
+            if j < len(keys) and int(keys[j]) == key:
+                return int(nexts[j])
+            return int(default)
+    """})
+    assert clean == []
+
+
 def test_real_fleet_balancer_guard_decls_are_collected():
     """FleetBalancer's replica-table declaration reaches the guarded-by
     checker (the rot-guard pattern: the declaration syntax must not

@@ -222,6 +222,7 @@ def test_empty_prompt_fails_cleanly(stack):
         sched.stop()
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_warmup_engine_compiles_without_polluting_stats(tiny_model):
     """warmup_engine pre-compiles every serving program (prefill buckets,
     decode, spec verify) and restores the stats counters, so a warmed

@@ -677,7 +677,7 @@ def test_worker_serve_protocol_errors_do_not_burn_restarts():
         def __init__(self):
             self.calls = 0
 
-        def decode(self, *a, want_logits=True):
+        def decode(self, *a, want_logits=True, g_states=None):
             self.calls += 1
 
     script = [OP_DECODE, "torn", OP_DECODE, "skewed", OP_DECODE,
@@ -729,7 +729,7 @@ def test_worker_serve_engine_errors_still_bounded():
         def __init__(self):
             self.calls = 0
 
-        def decode(self, *a, want_logits=True):
+        def decode(self, *a, want_logits=True, g_states=None):
             self.calls += 1
             raise RuntimeError(f"replay #{self.calls}")
 

@@ -266,6 +266,7 @@ def test_scheduler_fused_off_escape_hatch(loaded):
     assert stats["pipeline_flushes"] >= 1  # the admission cut the chain
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_scheduler_fused_stop_string_under_churn(loaded):
     """A stop string firing on a live lane while an admission's chunks are
     in flight: the lagged consume discards the lane's junk steps and both
@@ -379,6 +380,7 @@ def test_scheduler_fused_cancel_mid_admission(loaded):
     assert list(survivor.generated_tokens) == base[0]
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_scheduler_fused_prefix_cache_tail(loaded):
     """Satellite: an admission whose prompt prefix is already resident
     (a finished lane's KV) prefills only the TAIL through the fused step —
@@ -554,7 +556,8 @@ def test_pod_packet_replays_decode_prefill_fused():
         def decode_prefill_fused(self, positions, temps=None, topps=None,
                                  seeds=None, p_lane=0, chunk=None,
                                  p_start=0, p_temp=0.0, p_topp=0.9,
-                                 p_seed=0, tokens=None):
+                                 p_seed=0, tokens=None, g_states=None,
+                                 p_g=0):
             self._ring += 1
             calls.append((
                 "fused",

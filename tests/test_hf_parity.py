@@ -123,6 +123,7 @@ def _ours_logits(m_path: str, tokens: list[int]) -> np.ndarray:
     return np.asarray(logits[0])  # [T, vocab]
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 @pytest.mark.parametrize("model_type", ["llama", "qwen2"])
 def test_logits_match_transformers(model_type, tmp_path):
     cfg = _tiny_cfg(model_type)

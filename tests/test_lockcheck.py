@@ -286,6 +286,7 @@ def test_real_qos_and_telemetry_paths_clean(witness_on):
     assert witness_on.held() == ()
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_qos_and_telemetry_suites_clean_under_lockcheck():
     """The tier-1 fixture the issue asks for: rerun the QoS + telemetry
     suites in a subprocess with DLLAMA_LOCKCHECK=1, so EVERY lock they

@@ -380,7 +380,7 @@ class _ScriptedEngine:
         self.calls = 0
         self.fail_on = set(fail_on)
 
-    def decode(self, *a, want_logits=True):
+    def decode(self, *a, want_logits=True, g_states=None):
         self.calls += 1
         if self.calls in self.fail_on:
             raise RuntimeError(f"transient #{self.calls}")

@@ -210,7 +210,8 @@ def test_pod_packet_replays_decode_multi():
             def reset():
                 pass
 
-        def decode_multi(self, tokens, positions, temps, topps, seeds, h):
+        def decode_multi(self, tokens, positions, temps, topps, seeds, h,
+                         g_states=None):
             calls.append((
                 np.asarray(tokens).tolist(), np.asarray(positions).tolist(),
                 np.asarray(temps).tolist(), np.asarray(seeds).tolist(), h,

@@ -165,6 +165,7 @@ def test_default_topp_single_source(loaded):
     np.testing.assert_array_equal(s_default, s_explicit)
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_warmup_covers_horizon_set_and_pipeline(loaded):
     """Satellite: warmup compiles every multi-step horizon bucket the
     scheduler can pick (not just the top one) and the pipelined step, so
@@ -298,6 +299,7 @@ def test_scheduler_pipelined_cancel_mid_stream(loaded):
     assert pl[1] == base[1]  # the surviving lane is byte-identical
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_scheduler_pipelined_with_speculation(loaded):
     """speculative=True: drafts force a pipeline flush and the spec path
     runs (it wins steady-state greedy); streams still match the
@@ -542,7 +544,7 @@ def test_pod_packet_replays_decode_pipelined():
             self._ring = 0
 
         def decode_pipelined(self, positions, temps=None, topps=None,
-                             seeds=None, tokens=None):
+                             seeds=None, tokens=None, g_states=None):
             self._ring += 1
             calls.append((
                 "dispatch",
@@ -627,7 +629,7 @@ def test_pod_packet_decode_want_logits_flag():
         n_lanes = 2
 
         def decode(self, tokens, positions, temps=None, topps=None,
-                   seeds=None, want_logits=True):
+                   seeds=None, want_logits=True, g_states=None):
             seen.append(want_logits)
 
     plane = _Plane()

@@ -130,6 +130,7 @@ def test_scheduler_prefix_hit_skips_prefill_and_keeps_stream(loaded):
     assert engine.stats.prefill_tokens < plain_engine.stats.prefill_tokens
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_scheduler_prefix_concurrent_batch_identical_streams(loaded):
     """Two concurrent requests sharing a prefix (second admitted while the
     first may still be prefilling — only committed chunks are reusable):
@@ -303,6 +304,7 @@ def test_kvpool_exhaustion_evicts_parked_then_sheds():
     assert pool.stats()["pool_exhausted_sheds"] == 1
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_paged_table_updates_keep_mesh_sharding(loaded):
     """Table replacements must carry the cache's replicated NamedSharding
     on a mesh: a bare jnp.asarray leaf changes the compiled programs'
@@ -733,6 +735,7 @@ def test_paged_engine_refuses_copy_lane(loaded):
         eng.copy_lane(0, 1)
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_paged_streams_byte_identical_vs_contiguous_churn(loaded):
     """THE paged pin: the same churn (sequential shared-prefix requests,
     then a concurrent mixed batch) over a paged engine and a contiguous
@@ -848,6 +851,7 @@ def test_paged_park_drop_journal_rebuild_byte_identical(loaded, tmp_path):
         assert e.finished
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_prefix_reuse_survives_idle_lane_decode_steps(loaded):
     """Round-5 code-review finding: every decode step scatters a KV write
     for EVERY lane; idle/finished lanes used to point at position 0,

@@ -88,6 +88,7 @@ def test_ring_all_reduce_matches_psum(tp):
     assert np.array_equal(got, want)  # integer-valued: exact either way
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_ring_all_reduce_random_f32_tolerance():
     """Random f32: ring order vs XLA's reduction tree differ only in
     associativity — same f32 class."""
@@ -183,6 +184,7 @@ def test_ring_sync_matmul_dense(tp):
     assert np.allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_ring_sync_matmul_packed_q40():
     """The serving form: col-sliced PackedQ40 planes, dequant-in-matmul
     per column chunk, ring-reduced — matches the unsharded Q40 matmul."""
@@ -197,6 +199,7 @@ def test_ring_sync_matmul_packed_q40():
     assert np.abs(got - want).max() / scale < 1e-5
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_ring_sync_matmul_q80_wire():
     """Q80 wire engages on the gather half only: within the reference
     transport's ~1e-2 class of the f32-wire result."""

@@ -449,7 +449,7 @@ def test_pod_packet_replays_decode_spec_pipelined():
 
         def decode_spec_pipelined(self, positions, drafts, draft_len,
                                   temps=None, topps=None, seeds=None,
-                                  tokens=None):
+                                  tokens=None, g_states=None):
             self._ring += 1
             calls.append((
                 "spec",
@@ -528,7 +528,7 @@ def test_pod_packet_replays_decode_spec_prefill_fused():
                                       temps=None, topps=None, seeds=None,
                                       p_lane=0, chunk=None, p_start=0,
                                       p_temp=0.0, p_topp=0.9, p_seed=0,
-                                      tokens=None):
+                                      tokens=None, g_states=None, p_g=0):
             calls.append((
                 "specfused",
                 np.asarray(drafts).tolist(),
@@ -607,7 +607,7 @@ def test_root_engine_validates_spec_dispatch_before_broadcast():
                 raise ValueError(f"spec drafts shape != {want}")
 
         def check_spec_pipelined_dispatch(self, drafts, reseed,
-                                          positions=None):
+                                          positions=None, g_states=None):
             self.check_spec_drafts(drafts)
 
     root = mh.RootControlEngine(_Eng(), _Plane())

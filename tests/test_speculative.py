@@ -177,7 +177,8 @@ def test_pod_root_engine_broadcasts_spec():
         supports_speculative = True
 
         def decode_spec(self, tokens, drafts, draft_len, positions,
-                        temps=None, topps=None, seeds=None):
+                        temps=None, topps=None, seeds=None,
+                        g_states=None):
             return "logits", np.zeros((2, 4), np.int32), np.ones(2, np.int32)
 
     plane = _Plane(n_lanes=2, chunk=8)

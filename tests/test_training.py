@@ -49,6 +49,7 @@ def test_loss_decreases_over_steps():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_save_restore_resume_is_exact(tmp_path):
     config = _config()
     batches = _batches(config, 4)
@@ -162,6 +163,7 @@ def test_train_step_on_mesh_matches_single_device():
     np.testing.assert_allclose(loss_mesh, loss_plain, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)
 def test_mesh_sharded_save_restore_resume_exact(tmp_path):
     """Checkpoint/resume with GSPMD-sharded params: restore_args carry the
     trainer's shardings, so a mesh trainer resumes straight into its
