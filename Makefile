@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint lockgraph sanitize dryrun chaos fleet clean
+.PHONY: all native test verify lint lockgraph protocol sanitize dryrun chaos fleet clean
 
 all: native
 
@@ -86,6 +86,17 @@ fleet:
 # to eyeball in review.
 lockgraph:
 	python -m distributed_llama_multiusers_tpu.analysis --graph
+
+# Reviewer aid for packet-layout changes (ROADMAP item 5 adds new ops +
+# a shipped-KV-page replay surface): the wire-protocol op table
+# extracted from parallel/multihost.py — op value, encoder, replay-arm
+# line, fixed header widths — plus the diff vs the pinned
+# analysis/protocol.lock. `make lint` FAILS when the layout changed at
+# the same PROTOCOL_VERSION (docs/LINT.md "protocol-manifest"); after a
+# legitimate bump, re-pin with
+# `python -m distributed_llama_multiusers_tpu.analysis --update-protocol-manifest`.
+protocol:
+	python -m distributed_llama_multiusers_tpu.analysis --protocol-table
 
 # ASan+UBSan gate for the native codec (the reference's sanitizer-CI
 # analogue, SURVEY.md §5.2): rebuilds the .so instrumented and reruns the

@@ -8,11 +8,15 @@ reviewer memory. This package machine-checks them — the Python/JAX
 analogue of the reference repo's sanitizer CI for C++ (SURVEY.md §5.2,
 mirrored by ``make sanitize``).
 
-Ten checks (docs/LINT.md has the full contract and waiver policy). The
-four ``lock-*``/``pod-*`` checks are the v2 cross-file concurrency layer:
-they share one lock model (lockgraph.py) of every class-qualified lock in
-the package, and the statically computed lock-order graph doubles as the
-runtime witness's seed (lockcheck.py, ``DLLAMA_LOCKCHECK=1``).
+Thirteen checks (docs/LINT.md has the full contract and waiver policy).
+The four ``lock-*``/``pod-*`` checks are the v2 cross-file concurrency
+layer: they share one lock model (lockgraph.py) of every class-qualified
+lock in the package, and the statically computed lock-order graph doubles
+as the runtime witness's seed (lockcheck.py, ``DLLAMA_LOCKCHECK=1``).
+The ``protocol*``/``replay-determinism`` checks are the v3 wire-protocol
+layer: a surface model of ``parallel/multihost.py`` (protocol_check.py)
+pinned by ``analysis/protocol.lock``, plus a declared determinism scope
+over the journal/recovery/migration/grammar replay closure.
 
 - ``lock-order``     — the cross-file "held while acquiring" graph over
   declared locks stays acyclic (one level of intra-package calls
@@ -25,6 +29,15 @@ runtime witness's seed (lockcheck.py, ``DLLAMA_LOCKCHECK=1``).
 - ``pod-broadcast``  — multihost proxy methods: validate, broadcast,
   compute — nothing raises/returns between a packet and its paired
   engine call
+- ``protocol``       — the pod wire-protocol surface model: every op has
+  an encoder and a replay arm, slot indices stay < SLOTS, broadcasts
+  are validated pre-broadcast, header widths agree encoder<->replay
+- ``protocol-manifest`` — the extracted packet layout matches the pinned
+  ``analysis/protocol.lock`` unless PROTOCOL_VERSION was bumped in the
+  same diff (``--update-protocol-manifest`` regenerates the pin)
+- ``replay-determinism`` — no unjournaled entropy, builtin ``hash()``,
+  or set-iteration ordering inside the journal/recovery/migration/
+  grammar replay scope
 - ``host-sync``      — explicit, waived device->host transfers in decode
 - ``pipeline-sync``  — NO host syncs at all in the async-pipeline dispatch
   half (engine.decode_pipelined / scheduler._pipeline_dispatch)

@@ -8,12 +8,22 @@ error. Pure stdlib — runs before any jax/numpy import is possible, so
 from __future__ import annotations
 
 import argparse
+import ast
+import json
+import subprocess
 import sys
 from pathlib import Path
 
 from .core import Analyzer, iter_py_files, load_baseline, write_baseline
 from .formats import render_github, render_sarif, render_text
 from .lockgraph import scan_paths
+from .protocol_check import (
+    extract_protocol,
+    manifest_diff,
+    manifest_from_model,
+    manifest_path_for,
+    write_protocol_manifest,
+)
 from .registry import default_checkers
 
 PACKAGE_ROOT = Path(__file__).resolve().parent.parent  # the package dir
@@ -67,7 +77,136 @@ def build_parser() -> argparse.ArgumentParser:
         "sites, waived edges dashed; reviewers of new lock code eyeball "
         "the new edges here",
     )
+    ap.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="REF",
+        help="lint only files changed vs a git ref (default HEAD when the "
+        "flag is bare); cross-file checks still load the whole model, "
+        "and the run falls back to a full lint when git is unavailable",
+    )
+    ap.add_argument(
+        "--update-protocol-manifest", action="store_true",
+        help="re-pin analysis/protocol.lock from the current "
+        "parallel/multihost.py layout (run after a PROTOCOL_VERSION "
+        "bump) and exit",
+    )
+    ap.add_argument(
+        "--protocol-table", action="store_true",
+        help="print the extracted pod wire-protocol op table plus the "
+        "diff vs the pinned manifest, and exit — the reviewer aid for "
+        "packet-layout changes (`make protocol`)",
+    )
     return ap
+
+
+def git_changed_files(
+    ref: str, anchor: Path
+) -> tuple[Path, set[Path]] | None:
+    """``(repo_root, changed)``: absolute resolved paths changed vs
+    ``ref`` (diff + untracked) in the git repo containing ``anchor``.
+    Returns None when git is unavailable or ``anchor`` is not inside a
+    work tree (the caller falls back to a full run — degraded scope
+    must only ever GROW coverage); raises ValueError when the repo
+    resolves but ``ref`` does not (a typo'd ref is a usage error, not
+    a fallback). The repo root lets the caller treat analyzed files
+    OUTSIDE this repo as always-checked rather than silently skipped."""
+    anchor = anchor if anchor.is_dir() else anchor.parent
+
+    def _git(*args: str) -> subprocess.CompletedProcess | None:
+        try:
+            return subprocess.run(
+                ["git", "-C", str(anchor), *args],
+                capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+
+    top = _git("rev-parse", "--show-toplevel")
+    if top is None or top.returncode != 0:
+        return None
+    repo_root = Path(top.stdout.strip())
+    # both listings must be repo-root-relative: diff already is;
+    # ls-files is cwd-relative without --full-name
+    diff = _git("diff", "--name-only", "-z", ref, "--")
+    if diff is None:
+        return None
+    if diff.returncode != 0:
+        raise ValueError(
+            f"--changed {ref}: {diff.stderr.strip() or 'git diff failed'}"
+        )
+    names = [n for n in diff.stdout.split("\0") if n]
+    untracked = _git("ls-files", "--others", "--exclude-standard",
+                     "--full-name", "-z")
+    if untracked is None or untracked.returncode != 0:
+        # an untracked file with a real finding must not vanish from
+        # scope because ls-files hiccuped — degraded git state falls
+        # back to the FULL run, never a silently smaller one
+        return None
+    names.extend(n for n in untracked.stdout.split("\0") if n)
+    return repo_root.resolve(), {(repo_root / n).resolve() for n in names}
+
+
+def _find_multihost(paths: list[Path]) -> Path | None:
+    for p in iter_py_files(paths):
+        if p.as_posix().endswith("parallel/multihost.py"):
+            return p
+    return None
+
+
+def _protocol_table(paths: list[Path]) -> int:
+    target = _find_multihost(paths)
+    if target is None:
+        print("dlint: no parallel/multihost.py under the given paths",
+              file=sys.stderr)
+        return 2
+    model = extract_protocol(
+        ast.parse(target.read_text(encoding="utf-8")), str(target)
+    )
+    if model is None:
+        print(f"dlint: {target} declares no PROTOCOL_VERSION",
+              file=sys.stderr)
+        return 2
+    enc_by_op = {e.op: e for e in model.encoders.values() if e.op}
+    print(f"protocol v{model.version}  HEADER={model.header}  "
+          f"SLOTS={model.slots}  ({target})")
+    print(f"{'op':34s} {'value':>5s}  {'encoder':30s} {'replay arm':>10s}  "
+          "header widths")
+    for name, value in sorted(model.ops.items(), key=lambda kv: kv[1]):
+        enc = enc_by_op.get(name)
+        arm = model.arms.get(name)
+        widths = "" if enc is None or not enc.widths else " ".join(
+            f"slot{s}={w}" for s, (w, _) in sorted(enc.widths.items())
+        )
+        print(f"{name:34s} {value:5d}  "
+              f"{(enc.name if enc else '— MISSING —'):30s} "
+              f"{('line ' + str(arm.line)) if arm else 'MISSING':>10s}  "
+              f"{widths}")
+    lock = manifest_path_for(target)
+    if not lock.exists():
+        print(f"\nmanifest: MISSING ({lock}) — run "
+              "--update-protocol-manifest")
+        return 0
+    try:
+        pinned = json.loads(lock.read_text(encoding="utf-8"))
+    except (OSError, ValueError) as e:
+        print(f"\nmanifest: UNREADABLE ({e})")
+        return 0
+    current = manifest_from_model(model)
+    diffs = manifest_diff(pinned, current)
+    if pinned.get("protocol_version") != current["protocol_version"]:
+        print(f"\nmanifest: pinned v{pinned.get('protocol_version')}, "
+              f"extracted v{current['protocol_version']} (bump in flight "
+              "— regenerate with --update-protocol-manifest)")
+        for d in diffs:
+            print(f"  {d}")
+    elif diffs:
+        print(f"\nmanifest: LAYOUT DRIFT at the same version "
+              f"(v{current['protocol_version']}) — `make lint` will fail:")
+        for d in diffs:
+            print(f"  {d}")
+    else:
+        print(f"\nmanifest: in sync ({lock.name}, "
+              f"v{current['protocol_version']})")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -83,17 +222,55 @@ def main(argv=None) -> int:
         if not p.exists():
             print(f"dlint: no such path: {p}", file=sys.stderr)
             return 2
+    if args.update_protocol_manifest:
+        target = _find_multihost(paths)
+        if target is None:
+            print("dlint: no parallel/multihost.py under the given paths",
+                  file=sys.stderr)
+            return 2
+        lock = write_protocol_manifest(target)
+        print(f"dlint: wrote protocol manifest {lock}")
+        return 0
+    if args.protocol_table:
+        return _protocol_table(paths)
     analyzer = Analyzer(checkers)
     if args.graph:
         model = scan_paths(paths, valid_checks=analyzer.valid_checks)
         model.ensure_semantics()
         print(model.dot())
         return 0
+    check_only = None
+    if args.changed is not None:
+        if args.write_baseline:
+            # check_only would truncate the baseline to the changed
+            # files' findings, silently un-baselining everything else
+            print("dlint: --changed cannot be combined with "
+                  "--write-baseline (the baseline must cover the whole "
+                  "tree)", file=sys.stderr)
+            return 2
+        try:
+            got = git_changed_files(args.changed, paths[0])
+        except ValueError as e:
+            print(f"dlint: {e}", file=sys.stderr)
+            return 2
+        if got is None:
+            print("dlint: --changed: git unavailable here; falling back "
+                  "to a full run", file=sys.stderr)
+        else:
+            repo_root, check_only = got
+            # analyzed paths OUTSIDE the anchored repo have no diff to
+            # consult — always-checked, never silently skipped (the
+            # degraded-scope-only-grows rule)
+            check_only |= {
+                q for q in (p.resolve() for p in iter_py_files(paths))
+                if not q.is_relative_to(repo_root)
+            }
     baseline = (
         set() if (args.no_baseline or args.write_baseline)
         else load_baseline(args.baseline)
     )
-    findings = analyzer.run(paths, baseline=baseline, root=REPO_ROOT)
+    findings = analyzer.run(paths, baseline=baseline, root=REPO_ROOT,
+                            check_only=check_only)
     if args.write_baseline:
         # waiver/parse findings are never baseline-filtered by the analyzer,
         # so writing their keys would only accumulate dead entries while the
@@ -119,11 +296,16 @@ def main(argv=None) -> int:
         lines = render_text(findings)
     for line in lines:
         print(line)
-    n_files = len(iter_py_files(paths))
+    all_files = iter_py_files(paths)
+    if check_only is None:
+        scope = f"{len(all_files)} file(s)"
+    else:
+        n = sum(1 for p in all_files if p.resolve() in check_only)
+        scope = f"{n} changed of {len(all_files)} file(s)"
     if findings:
         if args.format == "text":
-            print(f"dlint: {len(findings)} finding(s) in {n_files} file(s)")
+            print(f"dlint: {len(findings)} finding(s) in {scope}")
         return 1
     if args.format == "text":
-        print(f"dlint: clean ({n_files} file(s))")
+        print(f"dlint: clean ({scope})")
     return 0

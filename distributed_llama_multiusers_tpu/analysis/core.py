@@ -292,16 +292,35 @@ class Analyzer:
         paths: Iterable[Path | str],
         baseline: set[str] | None = None,
         root: Path | None = None,
+        check_only: set[Path] | None = None,
     ) -> list[Finding]:
+        """``check_only`` (resolved absolute paths — the ``--changed``
+        mode): EVERY file still feeds the collect phase, so cross-file
+        models (locks, guarded-by decls, the protocol surface) stay
+        complete, but per-file ``check`` findings (and waiver hygiene)
+        are reported only for the listed files. ``finalize`` findings
+        are cross-file by definition and always reported — as are
+        ``parse`` findings from ANY file, since an unparseable file is
+        a hole in the cross-file model no matter what changed."""
         baseline = baseline or set()
         files: list[SourceFile] = []
         findings: list[Finding] = []
+
+        def _checked(p: Path) -> bool:
+            return check_only is None or p.resolve() in check_only
+
         for p in iter_py_files(paths):
             display = _display_path(p, root)
             try:
                 text = p.read_text(encoding="utf-8")
                 tree = ast.parse(text, filename=str(p))
             except (OSError, SyntaxError, ValueError) as e:
+                # unconditionally, check_only included: an unparseable
+                # file is MISSING from the cross-file model (locks,
+                # guarded-by decls, the protocol surface), so a
+                # --changed run reporting clean against the incomplete
+                # model would be a lie — parse findings are unwaivable
+                # hygiene and stay loud
                 findings.append(Finding(
                     "parse", display, getattr(e, "lineno", 0) or 0,
                     f"cannot analyze: {type(e).__name__}: {e}",
@@ -309,18 +328,24 @@ class Analyzer:
                 continue
             sf = SourceFile(path=p, display=display, text=text, tree=tree)
             sf.waivers, wf = parse_waivers(text, self.valid_checks, display)
-            findings.extend(wf)  # waiver-syntax findings: never waivable
+            if _checked(p):
+                findings.extend(wf)  # waiver-syntax findings: never waivable
             files.append(sf)
 
         project = Project()
         for checker in self.checkers:
             for sf in files:
                 checker.collect(sf, project)
-        findings.extend(project.collect_findings)
+        checked_displays = {sf.display for sf in files if _checked(sf.path)}
+        findings.extend(
+            f for f in project.collect_findings if f.path in checked_displays
+        )
 
         sf_by_display = {sf.display: sf for sf in files}
         for checker in self.checkers:
             for sf in files:
+                if not _checked(sf.path):
+                    continue
                 for f in checker.check(sf, project):
                     findings.append(f)
         for checker in self.checkers:

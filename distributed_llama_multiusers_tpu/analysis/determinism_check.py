@@ -1,0 +1,257 @@
+"""replay-determinism: nothing nondeterministic inside the replay closure.
+
+The crash-recovery / fleet-migration / grammar stack (PRs 10-13) is
+correct because generation is a pure function of the journaled admit
+record: (prompt tokens, resolved sampler seed, params, schema). Three
+review rounds on PR 10 alone were spent finding the leaks that break
+that closure — an unjournaled entropy draw, a ``hash()`` that changes
+per process (PYTHONHASHSEED randomization — the hazard
+``fleet/balancer.stable_hash``'s crc32 exists to dodge), a ``set``
+whose iteration order feeds serialized output. This check mechanizes
+the rule over a declared scope:
+
+- ``serving/journal.py`` / ``serving/recovery.py`` — the admit record
+  and its replay;
+- ``fleet/migrate.py`` — the same record as a live-migration ticket;
+- ``grammar/automaton.py`` — schema canonicalization (every process
+  must compile the identical automaton from the broadcast bytes);
+- ``runtime/scheduler.py`` — the admit-record build and everything
+  around it;
+- ``app/dllama.py`` — the CLI's seed handling (the training batch
+  stream replays on resume).
+
+Findings, unless waived with ``ok[replay-determinism] <reason naming
+the journaled draw>``:
+
+- **entropy**: ``random.*`` / ``np.random.*`` / ``os.urandom`` /
+  ``uuid.uuid*`` / ``secrets.*``. The ONE sanctioned source is
+  ``utils.seeds.fresh_seed()`` — its draw is resolved at admission and
+  journaled in the admit record, so replay re-reads the recorded value
+  instead of re-drawing. Explicitly seeded RNG construction
+  (``np.random.default_rng(seed)`` with a resolved seed argument) is
+  deterministic and allowed; the argument-less form is the hazard.
+- **builtin ``hash()``**: varies per process for str/bytes under hash
+  randomization — two replicas disagree on anything derived from it.
+- **set iteration**: ``for x in {...}`` / ``set(...)`` — iteration
+  order is hash-order; ``sorted(...)`` the set before it can feed a
+  record, packet, or replayed stream.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Finding, Project, SourceFile, walk_with_ancestors
+from .lockgraph import walk_excluding_nested_defs
+
+SCOPE = (
+    # the journal IS the replay closure; recovery replays it
+    "serving/journal.py", "serving/recovery.py",
+    # migration ships the same admit record between replicas
+    "fleet/migrate.py",
+    # schema canonicalization: every process compiles the same automaton
+    "grammar/automaton.py",
+    # the admit-record build (resolved seed, QoS class, deadlines)
+    "runtime/scheduler.py",
+    # CLI seed handling: the no-seed case must route through fresh_seed
+    "app/dllama.py",
+)
+
+# dotted prefixes that ARE entropy (resolved through import aliases)
+ENTROPY_PREFIXES = ("random.", "numpy.random.", "secrets.")
+ENTROPY_EXACT = {"os.urandom", "os.getrandom", "uuid.uuid1", "uuid.uuid3",
+                 "uuid.uuid4", "uuid.uuid5", "uuid.getnode"}
+# RNG constructors that are deterministic WHEN explicitly seeded
+SEEDED_CTORS = {"default_rng", "Generator", "SeedSequence", "RandomState",
+                "Random", "PCG64", "Philox"}
+# `from <module> import <name>` bindings banned at the import line —
+# a bare-Name call site is invisible to the Attribute resolver, so the
+# import IS the finding ("*" = every name except the seeded
+# constructors above)
+BANNED_FROM = {"random": "*", "secrets": "*", "numpy.random": "*",
+               "os": {"urandom", "getrandom"},
+               "uuid": {"uuid1", "uuid3", "uuid4", "uuid5", "getnode"}}
+
+_FIX = (
+    "— replay must re-derive byte-identical state; draw through "
+    "utils.seeds.fresh_seed() at admission and journal the result (the "
+    "admit-record pattern), or waive naming the journaled draw"
+)
+
+
+class ReplayDeterminismChecker(Checker):
+    name = "replay-determinism"
+    description = (
+        "no unjournaled entropy, builtin hash(), or set-iteration order "
+        "inside the journal/recovery/migration/grammar replay scope"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        if not sf.endswith(*SCOPE):
+            return
+        aliases = self._aliases(sf.tree)
+        yield from self._check_imports(sf)
+        shadowed_hash = self._shadows_builtin_hash(sf.tree, aliases)
+        yield from self._check_set_iteration(sf)
+
+        for node, ancestors in walk_with_ancestors(sf.tree):
+            if isinstance(node, ast.Attribute):
+                dotted = self._resolve(node, aliases)
+                if dotted is None or not self._is_entropy(dotted):
+                    continue
+                if self._seeded_ctor_call(node, ancestors):
+                    continue
+                yield Finding(
+                    self.name, sf.display, node.lineno,
+                    f"'{ast.unparse(node)}' is an unjournaled entropy "
+                    f"source in the replay-determinism scope {_FIX}",
+                )
+            elif isinstance(node, ast.Call) and not shadowed_hash \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "hash":
+                yield Finding(
+                    self.name, sf.display, node.lineno,
+                    "builtin hash() varies per process under "
+                    "PYTHONHASHSEED randomization — two replicas disagree "
+                    "on anything derived from it; use a stable digest "
+                    "(fleet/balancer.stable_hash's crc32 recipe, zlib, "
+                    "hashlib) or waive naming why the value never leaves "
+                    "this process",
+                )
+
+    # -- entropy -------------------------------------------------------------
+
+    @staticmethod
+    def _aliases(tree: ast.Module) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        # `import os.path` binds the ROOT name `os` to
+                        # the ROOT module — mapping it to "os.path"
+                        # would resolve os.urandom as os.path.urandom
+                        # and let the entropy draw escape
+                        root = a.name.split(".")[0]
+                        aliases[root] = root
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def _check_imports(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.ImportFrom) and node.module):
+                continue
+            banned = BANNED_FROM.get(node.module)
+            if banned is None:
+                continue
+            for a in node.names:
+                if a.name in SEEDED_CTORS:
+                    continue
+                if banned == "*" or a.name in banned:
+                    yield Finding(
+                        self.name, sf.display, node.lineno,
+                        f"'from {node.module} import {a.name}' binds an "
+                        f"entropy source in the replay-determinism scope "
+                        f"{_FIX}",
+                    )
+
+    def _resolve(self, node: ast.Attribute, aliases: dict[str, str]) -> str | None:
+        parts = [node.attr]
+        cur = node.value
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name) or cur.id not in aliases:
+            return None  # only imported roots: `self.random.x` is not the
+            # random module
+        return ".".join([aliases[cur.id], *reversed(parts)])
+
+    @staticmethod
+    def _is_entropy(dotted: str) -> bool:
+        return dotted in ENTROPY_EXACT or any(
+            dotted.startswith(p) for p in ENTROPY_PREFIXES
+        )
+
+    @staticmethod
+    def _seeded_ctor_call(node: ast.Attribute, ancestors) -> bool:
+        """``np.random.default_rng(resolved_seed)`` is a deterministic
+        construction, not a draw — allowed when explicitly seeded."""
+        if node.attr not in SEEDED_CTORS or not ancestors:
+            return False
+        parent = ancestors[-1]
+        return (isinstance(parent, ast.Call) and parent.func is node
+                and bool(parent.args or parent.keywords))
+
+    @staticmethod
+    def _shadows_builtin_hash(tree: ast.Module, aliases: dict[str, str]) -> bool:
+        if "hash" in aliases:
+            return True
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "hash":
+                return True
+        return False
+
+    # -- set iteration -------------------------------------------------------
+
+    @staticmethod
+    def _is_set_expr(node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in ("set", "frozenset"))
+
+    def _set_names_in(self, nodes) -> set[str]:
+        names: set[str] = set()
+        for node in nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and self._is_set_expr(node.value):
+                names.add(node.targets[0].id)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and isinstance(node.target, ast.Name) \
+                    and self._is_set_expr(node.value):
+                names.add(node.target.id)
+        return names
+
+    def _check_set_iteration(self, sf: SourceFile):
+        """Name-bound set iteration resolves PER SCOPE: module-level
+        bindings are visible everywhere, a function's own bindings only
+        inside it — `pending = {1, 2}` in one function must not convict
+        an unrelated `pending` list in another."""
+        module_names = self._set_names_in(walk_excluding_nested_defs(sf.tree))
+        yield from self._check_scope(
+            sf, list(walk_excluding_nested_defs(sf.tree)), module_names
+        )
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                body = list(walk_excluding_nested_defs(node))
+                yield from self._check_scope(
+                    sf, body, module_names | self._set_names_in(body)
+                )
+
+    def _check_scope(self, sf: SourceFile, nodes, set_names: set[str]):
+        for node in nodes:
+            if isinstance(node, ast.For):
+                yield from self._check_iter(sf, node.iter, set_names)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                for gen in node.generators:
+                    yield from self._check_iter(sf, gen.iter, set_names)
+
+    def _check_iter(self, sf: SourceFile, it: ast.AST, set_names: set[str]):
+        if self._is_set_expr(it) or (
+            isinstance(it, ast.Name) and it.id in set_names
+        ):
+            yield Finding(
+                self.name, sf.display, it.lineno,
+                f"iterating a set ('{ast.unparse(it)}') — iteration order "
+                "is hash order (PYTHONHASHSEED-randomized for str/bytes), "
+                "so anything it feeds into a journal record, packet, or "
+                "replayed stream differs across processes; sorted(...) it, "
+                "or waive naming why the order cannot leak",
+            )

@@ -1,0 +1,646 @@
+"""protocol / protocol-manifest: the pod wire protocol as a checked model.
+
+Scope: ``parallel/multihost.py`` (and fixture files with that suffix)
+that declare ``PROTOCOL_VERSION`` — the gate that keeps protocol-shaped
+test fixtures for OTHER checks out of this one's business.
+
+``parallel/multihost.py`` is at PROTOCOL_VERSION 4 after three
+hand-audited bumps, each justified by "a skewed peer could silently
+replay garbage". The invariants those audits re-derived every time are
+mechanical, so this module extracts a **protocol surface model** from
+the AST — op constants, ``send_*`` encoders (with the op each passes to
+``self._send``), ``RootControlEngine`` broadcast sites, ``worker_loop``
+replay arms, packet-slot indices, and fixed header widths (payloads
+built by ``np.zeros(<literal>)`` builders like ``_prefill_header``) —
+and checks it two ways:
+
+- ``protocol`` — structural pairing: every op has an encoder AND a
+  replay arm; no encoder writes a packet slot index >= ``SLOTS``; every
+  operand-carrying broadcast in a proxy method is PRECEDED by a
+  pre-broadcast validation (a ``check_*``/``validate*`` call, a
+  conditional ``raise``, or root-side ``self._engine`` work — the
+  pod-deadlock rule generalized beyond the ``pod-broadcast`` check's
+  raise-placement: a bad argument must die with zero packets on the
+  wire); fixed header widths agree between the encoder and the replay
+  arm that re-slices them.
+- ``protocol-manifest`` — the extracted layout is pinned in
+  ``analysis/protocol.lock`` (version, op table, HEADER/SLOTS, per-op
+  payload counts and header widths). A layout that differs from the
+  manifest WITHOUT a ``PROTOCOL_VERSION`` bump in the same diff is a
+  finding — "changed the packet without bumping the version" cannot
+  merge. A bump makes the check pass; regenerate the pin with
+  ``dlint --update-protocol-manifest`` (a tier-1 rot-guard keeps the
+  shipped manifest byte-current, so it cannot go stale either).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core import Checker, Finding, Project, SourceFile
+from .lockgraph import walk_excluding_nested_defs
+
+SCOPE = ("parallel/multihost.py",)
+OP_RE = re.compile(r"^OP_[A-Z0-9_]+$")
+BCAST_RE = re.compile(r"^self\._plane\.(send_\w+|_send)$")
+# a call spelled through any of these before the broadcast counts as
+# pre-broadcast validation (the raise may live inside the callee)
+VALIDATE_RE = re.compile(r"^_?(check|validate)|valid", re.IGNORECASE)
+MANIFEST_NAME = "protocol.lock"
+
+
+def _int_const(node: ast.AST) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, int)):
+        return -node.operand.value
+    return None
+
+
+def _last(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+@dataclass
+class EncoderInfo:
+    name: str
+    line: int
+    op: str | None = None  # OP_* constant passed to self._send
+    payloads: int = 0  # payload slots written (args past the 4 header args)
+    self_validating: bool = False  # raises / calls a _check* before _send
+    widths: dict[int, tuple[int, int]] = field(default_factory=dict)
+    # payload slot -> (fixed width from an np.zeros(<literal>) builder, line)
+
+
+@dataclass
+class ArmInfo:
+    op: str
+    line: int
+    # (slot index, literal width or None, line) for plane.slot(pkt, i, w)
+    slot_reads: list[tuple[int, int | None, int]] = field(default_factory=list)
+
+
+@dataclass
+class RootSendInfo:
+    cls: str
+    method: str
+    send_name: str
+    line: int
+    n_args: int
+    validated: bool  # some validation event precedes it in source order
+
+
+@dataclass
+class ProtocolModel:
+    display: str
+    version: int
+    version_line: int
+    header: int | None = None
+    slots: int | None = None
+    slots_line: int = 0
+    ops: dict[str, int] = field(default_factory=dict)
+    op_lines: dict[str, int] = field(default_factory=dict)
+    encoders: dict[str, EncoderInfo] = field(default_factory=dict)
+    arms: dict[str, ArmInfo] = field(default_factory=dict)
+    # a second `op == OP_X` arm is dead (shadowed) protocol surface
+    duplicate_arms: list[tuple[str, int]] = field(default_factory=list)
+    has_worker_loop: bool = False
+    worker_loop_line: int = 0
+    root_sends: list[RootSendInfo] = field(default_factory=list)
+    # pkt[lo:hi] header slices: (lo, hi, tuple_len or None, line)
+    header_slices: list[tuple[int, int, int | None, int]] = field(
+        default_factory=list
+    )
+
+
+def extract_protocol(tree: ast.Module, display: str) -> ProtocolModel | None:
+    """Build the surface model; None when the file declares no
+    ``PROTOCOL_VERSION`` (not a protocol file — fixtures for other
+    checks stay out of scope)."""
+    version = version_line = None
+    ops: dict[str, int] = {}
+    op_lines: dict[str, int] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        value = _int_const(node.value)
+        if value is None:
+            continue
+        if name == "PROTOCOL_VERSION":
+            version, version_line = value, node.lineno
+        elif OP_RE.match(name):
+            ops[name] = value
+            op_lines[name] = node.lineno
+    if version is None:
+        return None
+    model = ProtocolModel(display, version, version_line,
+                          ops=ops, op_lines=op_lines)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _scan_class(node, model)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "worker_loop":
+            model.has_worker_loop = True
+            model.worker_loop_line = node.lineno
+            _scan_worker_loop(node, model)
+    return model
+
+
+def _zeros_width(call: ast.AST) -> int | None:
+    """``np.zeros(<int literal>, ...)`` -> the literal; None otherwise."""
+    if isinstance(call, ast.Call) and _last(call.func) == "zeros" and call.args:
+        return _int_const(call.args[0])
+    return None
+
+
+def _scan_class(cls: ast.ClassDef, model: ProtocolModel) -> None:
+    # class-level HEADER / SLOTS literals
+    for stmt in cls.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            v = _int_const(stmt.value)
+            if v is None:
+                continue
+            if stmt.targets[0].id == "HEADER" and model.header is None:
+                model.header = v
+            elif stmt.targets[0].id == "SLOTS" and model.slots is None:
+                model.slots, model.slots_line = v, stmt.lineno
+
+    # header builders: methods assigning X = np.zeros(<literal>) and
+    # returning X (the _prefill_header shape) -> fixed payload width
+    builders: dict[str, int] = {}
+    methods = [s for s in cls.body
+               if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in methods:
+        zeroed: dict[str, int] = {}
+        returned: set[str] = set()
+        for node in walk_excluding_nested_defs(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                w = _zeros_width(node.value)
+                if w is not None:
+                    zeroed[node.targets[0].id] = w
+            elif isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+                returned.add(node.value.id)
+        for name, w in zeroed.items():
+            if name in returned:
+                builders[fn.name] = w
+
+    for fn in methods:
+        _scan_encoder(fn, builders, model)
+        _scan_proxy_method(cls.name, fn, model)
+
+
+def _scan_encoder(fn, builders: dict[str, int], model: ProtocolModel) -> None:
+    """A ``send_*`` method calling ``self._send(OP_X, lane, n, start_pos,
+    *payloads)`` is op X's encoder."""
+    if not fn.name.startswith("send_"):
+        return
+    # names assigned from a header-builder call inside this encoder
+    built: dict[str, int] = {}
+    for node in walk_excluding_nested_defs(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            comp = _last(node.value.func)
+            if comp in builders:
+                built[node.targets[0].id] = builders[comp]
+    events = []  # ((line, col), kind, node) sorted into source order —
+    # ast.walk is breadth-first, and "validation BEFORE the _send" is a
+    # lexical-order fact
+    for node in walk_excluding_nested_defs(fn):
+        pos = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+        if isinstance(node, ast.Raise):
+            events.append((pos, "validate", None))
+        elif isinstance(node, ast.Call):
+            comp = _last(node.func)
+            if comp == "_send":
+                events.append((pos, "send", node))
+            elif comp and VALIDATE_RE.match(comp):
+                events.append((pos, "validate", None))
+    events.sort(key=lambda e: e[0])
+    info = None
+    saw_validation = False
+    for _, kind, node in events:
+        if kind == "validate":
+            saw_validation = True
+            continue
+        if info is None:
+            info = EncoderInfo(fn.name, node.lineno,
+                               self_validating=saw_validation)
+            model.encoders[fn.name] = info
+        if node.args and isinstance(node.args[0], ast.Name) \
+                and OP_RE.match(node.args[0].id):
+            info.op = node.args[0].id
+        info.payloads = max(info.payloads, len(node.args) - 4)
+        for slot, arg in enumerate(node.args[4:]):
+            width = None
+            if isinstance(arg, ast.Name) and arg.id in built:
+                width = built[arg.id]
+            elif isinstance(arg, ast.Call) and _last(arg.func) in builders:
+                width = builders[_last(arg.func)]
+            if width is not None:
+                info.widths[slot] = (width, node.lineno)
+
+
+def _scan_proxy_method(cls_name: str, fn, model: ProtocolModel) -> None:
+    """RootControlEngine-style methods: ``self._plane.send_*`` sites plus
+    whether any validation event precedes them. Also collects
+    ``pkt[lo:hi] = (...)`` header-tuple assignments (the _send framing)."""
+    events = []  # ((line, col), kind, payload)
+    for node in walk_excluding_nested_defs(fn):
+        pos = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+        if isinstance(node, ast.Raise):
+            events.append((pos, "validate", None))
+        elif isinstance(node, ast.Call):
+            spelled = ast.unparse(node.func)
+            if BCAST_RE.match(spelled):
+                events.append((pos, "send", node))
+            elif spelled.startswith("self._engine."):
+                events.append((pos, "validate", None))
+            else:
+                comp = _last(node.func)
+                if comp and VALIDATE_RE.match(comp):
+                    events.append((pos, "validate", None))
+        elif isinstance(node, ast.Assign):
+            sl = _header_slice(node)
+            if sl is not None:
+                model.header_slices.append(sl)
+    events.sort(key=lambda e: e[0])
+    validated = False
+    for _, kind, node in events:
+        if kind == "validate":
+            validated = True
+        elif kind == "send":
+            model.root_sends.append(RootSendInfo(
+                cls_name, fn.name,
+                ast.unparse(node.func).rsplit(".", 1)[-1],
+                node.lineno, len(node.args), validated,
+            ))
+            validated = True  # later sends in a loop share the gate
+
+
+def _header_slice(node: ast.Assign) -> tuple[int, int, int | None, int] | None:
+    """``pkt[0:6] = (<6-tuple>)`` -> (0, 6, 6, line)."""
+    if len(node.targets) != 1:
+        return None
+    t = node.targets[0]
+    if not (isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name)
+            and t.value.id == "pkt" and isinstance(t.slice, ast.Slice)):
+        return None
+    lo = 0 if t.slice.lower is None else _int_const(t.slice.lower)
+    hi = _int_const(t.slice.upper) if t.slice.upper is not None else None
+    if lo is None or hi is None:
+        return None
+    n = len(node.value.elts) if isinstance(node.value, (ast.Tuple, ast.List)) \
+        else None
+    return (lo, hi, n, node.lineno)
+
+
+def _arm_op(test: ast.AST) -> str | None:
+    """``op == OP_X`` -> ``"OP_X"``; None for any other test."""
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Eq)
+            and isinstance(test.left, ast.Name) and test.left.id == "op"
+            and isinstance(test.comparators[0], ast.Name)
+            and OP_RE.match(test.comparators[0].id)):
+        return test.comparators[0].id
+    return None
+
+
+def _scan_worker_loop(fn, model: ProtocolModel) -> None:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If):
+            op = _arm_op(node.test)
+            if op is None:
+                continue
+            arm = ArmInfo(op, node.lineno)
+            if op in model.arms:
+                model.duplicate_arms.append((op, node.lineno))
+                continue
+            model.arms[op] = arm
+            for inner in node.body:
+                for sub in ast.walk(inner):
+                    if isinstance(sub, ast.Call) and _last(sub.func) == "slot" \
+                            and len(sub.args) >= 3:
+                        slot = _int_const(sub.args[1])
+                        if slot is None:
+                            continue
+                        arm.slot_reads.append(
+                            (slot, _int_const(sub.args[2]), sub.lineno)
+                        )
+        elif isinstance(node, ast.Subscript):
+            # the header unpack read: pkt[2:6]
+            if (isinstance(node.value, ast.Name) and node.value.id == "pkt"
+                    and isinstance(node.slice, ast.Slice)
+                    and node.slice.lower is not None
+                    and node.slice.upper is not None):
+                lo = _int_const(node.slice.lower)
+                hi = _int_const(node.slice.upper)
+                if lo is not None and hi is not None:
+                    model.header_slices.append((lo, hi, None, node.lineno))
+
+
+# -- the manifest ------------------------------------------------------------
+
+
+def manifest_from_model(model: ProtocolModel) -> dict:
+    """The pinned layout: everything whose silent change is the
+    "skewed peer replays garbage" hazard the version word classifies."""
+    widths: dict[str, dict[str, int]] = {}
+    for enc in model.encoders.values():
+        if enc.op and enc.widths:
+            widths[enc.op] = {
+                str(slot): w for slot, (w, _) in sorted(enc.widths.items())
+            }
+    return {
+        "protocol_version": model.version,
+        "header": model.header,
+        "slots": model.slots,
+        "ops": dict(sorted(model.ops.items())),
+        "encoders": {
+            name: enc.op for name, enc in sorted(model.encoders.items())
+        },
+        "payload_slots": {
+            name: enc.payloads for name, enc in sorted(model.encoders.items())
+        },
+        "header_widths": widths,
+    }
+
+
+def render_manifest(manifest: dict) -> str:
+    return json.dumps(manifest, indent=2, sort_keys=True) + "\n"
+
+
+def manifest_path_for(multihost: Path) -> Path:
+    """``<pkg>/parallel/multihost.py`` -> ``<pkg>/analysis/protocol.lock``
+    — the same relative shape for the real tree and for fixtures."""
+    return multihost.resolve().parent.parent / "analysis" / MANIFEST_NAME
+
+
+def write_protocol_manifest(multihost: Path,
+                            lock_path: Path | None = None) -> Path:
+    src = Path(multihost).read_text(encoding="utf-8")
+    model = extract_protocol(ast.parse(src), str(multihost))
+    if model is None:
+        raise ValueError(
+            f"{multihost}: no PROTOCOL_VERSION found — not a protocol file"
+        )
+    out = lock_path if lock_path is not None else manifest_path_for(Path(multihost))
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(render_manifest(manifest_from_model(model)),
+                   encoding="utf-8")
+    return out
+
+
+def manifest_diff(pinned: dict, current: dict) -> list[str]:
+    """Human-readable field diffs, pinned -> current (version excluded —
+    the caller decides what a version delta means)."""
+    diffs: list[str] = []
+    for key in ("header", "slots"):
+        if pinned.get(key) != current.get(key):
+            diffs.append(f"{key}: {pinned.get(key)} -> {current.get(key)}")
+    for key in ("ops", "encoders", "payload_slots", "header_widths"):
+        old, new = pinned.get(key) or {}, current.get(key) or {}
+        for k in sorted(set(old) | set(new)):
+            if k not in new:
+                diffs.append(f"{key}[{k}] removed (was {old[k]})")
+            elif k not in old:
+                diffs.append(f"{key}[{k}] added ({new[k]})")
+            elif old[k] != new[k]:
+                diffs.append(f"{key}[{k}]: {old[k]} -> {new[k]}")
+    return diffs
+
+
+# -- the checkers ------------------------------------------------------------
+
+
+class ProtocolChecker(Checker):
+    name = "protocol"
+    description = (
+        "pod wire protocol surface: every op has an encoder and a replay "
+        "arm, no slot index >= SLOTS, operand-carrying broadcasts are "
+        "validated pre-broadcast, header widths agree encoder<->replay"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        if not sf.endswith(*SCOPE):
+            return
+        model = extract_protocol(sf.tree, sf.display)
+        if model is None:
+            return
+
+        # -- op table integrity
+        by_value: dict[int, str] = {}
+        for name, value in model.ops.items():
+            if value in by_value:
+                yield Finding(
+                    self.name, sf.display, model.op_lines[name],
+                    f"op value collision: {name} = {value} duplicates "
+                    f"{by_value[value]} — a replayed packet would take the "
+                    "wrong arm",
+                )
+            else:
+                by_value[value] = name
+
+        # "exactly one" cuts both ways: a second encoder for an op (two
+        # senders whose framings can drift) and a second replay arm (the
+        # later one is unreachable dead surface) are findings too
+        encoders_by_op: dict[str, list[EncoderInfo]] = {}
+        for enc in model.encoders.values():
+            if enc.op:
+                encoders_by_op.setdefault(enc.op, []).append(enc)
+        for op, encs in sorted(encoders_by_op.items()):
+            if len(encs) > 1:
+                encs.sort(key=lambda e: e.line)
+                for dup in encs[1:]:
+                    yield Finding(
+                        self.name, sf.display, dup.line,
+                        f"op {op} has more than one encoder "
+                        f"({', '.join(e.name for e in encs)}) — two "
+                        "framings of one op drift; exactly one send_* "
+                        "owns each packet layout",
+                    )
+        for op, line in model.duplicate_arms:
+            yield Finding(
+                self.name, sf.display, line,
+                f"duplicate replay arm for {op} — the first arm wins the "
+                "elif chain, so this one is unreachable dead protocol "
+                "surface",
+            )
+
+        encoded_ops = set(encoders_by_op)
+        for name in sorted(model.ops):
+            if name not in encoded_ops:
+                yield Finding(
+                    self.name, sf.display, model.op_lines[name],
+                    f"op {name} ({model.ops[name]}) has no send_* encoder "
+                    "passing it to self._send — an op nothing can emit is "
+                    "dead protocol surface (or its encoder bypasses the "
+                    "modelled framing)",
+                )
+        if model.has_worker_loop:
+            for name in sorted(model.ops):
+                if name not in model.arms:
+                    yield Finding(
+                        self.name, sf.display, model.op_lines[name],
+                        f"op {name} ({model.ops[name]}) has no replay arm "
+                        "in worker_loop — a root broadcasting it leaves "
+                        "every worker raising 'unknown control op' (or "
+                        "silently skewed)",
+                    )
+        elif model.ops:
+            yield Finding(
+                self.name, sf.display, model.version_line,
+                "protocol file declares ops but no worker_loop replay "
+                "switch — nothing replays the broadcasts",
+            )
+
+        # -- encoder sanity
+        for enc in model.encoders.values():
+            if enc.op is None:
+                yield Finding(
+                    self.name, sf.display, enc.line,
+                    f"encoder {enc.name} does not pass a literal OP_* "
+                    "constant as self._send's first argument — the op "
+                    "table cannot be modelled (or the op is computed, "
+                    "which a skewed peer cannot validate)",
+                )
+            elif enc.op not in model.ops:
+                yield Finding(
+                    self.name, sf.display, enc.line,
+                    f"encoder {enc.name} sends undeclared op {enc.op} — "
+                    "every op must be a module-level OP_* constant",
+                )
+            if model.slots is not None and enc.payloads > model.slots:
+                yield Finding(
+                    self.name, sf.display, enc.line,
+                    f"encoder {enc.name} writes payload slot "
+                    f"{enc.payloads - 1} but SLOTS is {model.slots} — the "
+                    "packet is sized for SLOTS payloads; later slots land "
+                    "out of bounds (or silently truncate)",
+                )
+
+        # -- replay-arm slot bounds + header-width agreement
+        if model.slots is not None:
+            for arm in model.arms.values():
+                for slot, _width, line in arm.slot_reads:
+                    if slot >= model.slots:
+                        yield Finding(
+                            self.name, sf.display, line,
+                            f"replay arm for {arm.op} reads packet slot "
+                            f"{slot} but SLOTS is {model.slots}",
+                        )
+        for enc in model.encoders.values():
+            arm = model.arms.get(enc.op or "")
+            if arm is None:
+                continue
+            for slot, (width, _line) in enc.widths.items():
+                for a_slot, a_width, a_line in arm.slot_reads:
+                    if a_slot == slot and a_width is not None \
+                            and a_width != width:
+                        yield Finding(
+                            self.name, sf.display, a_line,
+                            f"header width disagreement for {enc.op} slot "
+                            f"{slot}: encoder {enc.name} writes {width} "
+                            f"words, the replay arm reads {a_width} — the "
+                            "worker would decode a shifted header",
+                        )
+        if model.header is not None:
+            for lo, hi, tuple_len, line in model.header_slices:
+                if hi != model.header or (lo == 0 and tuple_len is not None
+                                          and tuple_len != model.header):
+                    yield Finding(
+                        self.name, sf.display, line,
+                        f"packet header slice pkt[{lo}:{hi}]"
+                        + (f" (tuple of {tuple_len})" if tuple_len else "")
+                        + f" disagrees with HEADER = {model.header}",
+                    )
+
+        # -- pre-broadcast validation (pod-deadlock rule, generalized)
+        for send in model.root_sends:
+            if send.n_args == 0:
+                continue  # operand-less ops (stop/flush/reset): nothing
+                # argument-dependent can raise post-send
+            enc = model.encoders.get(send.send_name)
+            if enc is not None and enc.self_validating:
+                continue  # the encoder raises before its own _send
+            if not send.validated:
+                yield Finding(
+                    self.name, sf.display, send.line,
+                    f"broadcast '{send.send_name}' in "
+                    f"{send.cls}.{send.method} has no pre-broadcast "
+                    "validation (no check_*/validate*/raise/self._engine "
+                    "call precedes it, and the encoder does not validate) "
+                    "— a bad argument would raise with the packet already "
+                    "on the wire and the pod deadlocks; validate BEFORE "
+                    "broadcasting",
+                )
+
+
+class ProtocolManifestChecker(Checker):
+    name = "protocol-manifest"
+    description = (
+        "extracted packet layout matches analysis/protocol.lock unless "
+        "PROTOCOL_VERSION was bumped; regenerate with "
+        "--update-protocol-manifest"
+    )
+
+    def check(self, sf: SourceFile, project: Project):
+        if not sf.endswith(*SCOPE):
+            return
+        model = extract_protocol(sf.tree, sf.display)
+        if model is None:
+            return
+        lock = manifest_path_for(sf.path)
+        if not lock.exists():
+            yield Finding(
+                self.name, sf.display, model.version_line,
+                f"no protocol manifest at {lock.name} — pin the current "
+                "layout with `dlint --update-protocol-manifest`",
+            )
+            return
+        try:
+            pinned = json.loads(lock.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as e:
+            yield Finding(
+                self.name, sf.display, model.version_line,
+                f"unreadable protocol manifest {lock.name} "
+                f"({type(e).__name__}: {e}) — regenerate with "
+                "`dlint --update-protocol-manifest`",
+            )
+            return
+        current = manifest_from_model(model)
+        if pinned.get("protocol_version") != current["protocol_version"]:
+            # the sanctioned path: the layout change came with a version
+            # bump in the same diff. The tier-1 manifest rot-guard
+            # (tests/test_protocol_lint.py) forces the regenerated pin
+            # into the same merge, so the manifest cannot go stale.
+            return
+        diffs = manifest_diff(pinned, current)
+        if diffs:
+            shown = "; ".join(diffs[:4]) + (
+                f"; … {len(diffs) - 4} more" if len(diffs) > 4 else ""
+            )
+            yield Finding(
+                self.name, sf.display, model.version_line,
+                f"packet layout changed without a PROTOCOL_VERSION bump "
+                f"(manifest pins v{pinned.get('protocol_version')}): "
+                f"{shown} — a skewed peer would frame this packet and "
+                "silently replay garbage; bump PROTOCOL_VERSION in the "
+                "same diff, then `dlint --update-protocol-manifest`",
+            )

@@ -1,4 +1,4 @@
-"""Checker registry: the ten project-invariant checks, in report order.
+"""Checker registry: the thirteen project-invariant checks, in report order.
 
 Order matters for collection: the lock-order checker's collect pass
 builds the shared cross-file lock model (``project.lock_model``) that
@@ -14,12 +14,14 @@ from .broadcast_check import PodBroadcastChecker
 from .clock_check import ClockChecker
 from .condvar_check import CondvarChecker
 from .core import Checker
+from .determinism_check import ReplayDeterminismChecker
 from .host_sync_check import HostSyncChecker
 from .lock_atomicity_check import LockAtomicityChecker
 from .lock_blocking_check import LockBlockingChecker
 from .lock_check import GuardedByChecker
 from .lock_order_check import LockOrderChecker
 from .pipeline_check import PipelineSyncChecker
+from .protocol_check import ProtocolChecker, ProtocolManifestChecker
 from .sharding_check import ShardingAxisChecker
 
 ALL_CHECKERS = (
@@ -28,6 +30,9 @@ ALL_CHECKERS = (
     LockBlockingChecker,
     LockAtomicityChecker,
     PodBroadcastChecker,
+    ProtocolChecker,
+    ProtocolManifestChecker,
+    ReplayDeterminismChecker,
     HostSyncChecker,
     PipelineSyncChecker,
     ClockChecker,

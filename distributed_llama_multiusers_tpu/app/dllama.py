@@ -41,7 +41,13 @@ def run_inference(args) -> None:
         if hasattr(engine, "stop_workers"):
             engine.stop_workers()  # release pod workers before exiting
         raise SystemExit(2)
-    sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or 12345)
+    # one-shot inference keeps a FIXED no-seed default (12345: benchmark
+    # runs stay reproducible without flags) — but `is not None`, not
+    # `or`: an explicit --seed 0 is a real seed, not "no seed"
+    sampler = Sampler(
+        config.vocab_size, args.temperature, args.topp,
+        args.seed if args.seed is not None else 12345,
+    )
 
     t0 = time.perf_counter()
     logits, greedy, pos = engine.prefill(0, tokens)
@@ -138,8 +144,12 @@ def run_chat(args) -> None:
     stops = TokenizerChatStops(tokenizer)
     # unseeded chats draw OS entropy (utils/seeds.py), not wall-clock
     # seconds: two sessions started in the same second must not replay
-    # identical sampling streams
-    sampler = Sampler(config.vocab_size, args.temperature, args.topp, args.seed or fresh_seed())
+    # identical sampling streams. `is not None`, not `or`: an explicit
+    # --seed 0 is a real (reproducible) seed, not "no seed"
+    sampler = Sampler(
+        config.vocab_size, args.temperature, args.topp,
+        args.seed if args.seed is not None else fresh_seed(),
+    )
     # greedy chat gets the same prompt-lookup speculation as inference mode
     # — the interactive path is where per-token latency is most visible,
     # and chat output (code, lists, repeated names) drafts well
@@ -320,8 +330,45 @@ def run_train(args) -> None:
         log("💾", f"Resumed from step {trainer.step_count} in {args.ckpt_dir}")
 
     # deterministic batch order: replay the skipped draws on resume so a
-    # resumed run consumes the same batches a straight run would
-    rng = np.random.default_rng(args.seed or 0)
+    # resumed run consumes the same batches a straight run would. An
+    # explicit --seed (0 included — `or 0` used to collapse --seed 0 and
+    # "no seed" into one stream) pins the order; the no-seed case draws
+    # OS entropy through the sanctioned source (utils/seeds.fresh_seed,
+    # dlint `replay-determinism`) and JOURNALS the draw in the
+    # checkpoint dir (the admit-record rule, CLI edition), so an
+    # unseeded run still resumes batch-for-batch from durable state
+    import pathlib
+
+    seed_file = (
+        pathlib.Path(args.ckpt_dir) / "batch_seed" if args.ckpt_dir else None
+    )
+    journaled = (
+        int(seed_file.read_text().strip())
+        if seed_file is not None and seed_file.exists() else None
+    )
+    batch_seed = args.seed
+    if batch_seed is None:
+        if journaled is not None:
+            batch_seed = journaled
+            log("🎲", f"Batch-order seed (journaled): {batch_seed}")
+        else:
+            batch_seed = fresh_seed()
+            log("🎲", f"Batch-order seed (drawn): {batch_seed}"
+                + ("" if seed_file is not None
+                   else " — pass --seed to reproduce"))
+    elif journaled is not None and journaled != batch_seed:
+        # explicit --seed wins, but silently diverging from the stream
+        # that produced the existing checkpoints is exactly the hazard
+        # the journal exists to prevent — say so
+        log("⚠️", f"--seed {batch_seed} overrides the journaled "
+            f"batch-order seed {journaled}: resumed batches will NOT "
+            "match the run that wrote these checkpoints")
+    # ALWAYS journal the resolved seed (explicitly seeded runs included):
+    # a later `--ckpt-dir`-only resume must replay the same stream
+    if seed_file is not None and journaled != batch_seed:
+        seed_file.parent.mkdir(parents=True, exist_ok=True)
+        seed_file.write_text(f"{batch_seed}\n")
+    rng = np.random.default_rng(batch_seed)
     for _ in range(trainer.step_count):
         rng.integers(0, n_win, size=args.batch_size)
 

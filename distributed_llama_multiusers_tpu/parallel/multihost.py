@@ -28,6 +28,19 @@ leaves every worker blocked inside a collective the root never
 dispatches — a hang with no timeout, invisible until the pod is dead.
 Relatedly, dlint's ``lock-blocking`` check forbids broadcasting while
 holding any declared lock anywhere in the package. See docs/LINT.md.
+
+Wire-protocol surface — MACHINE-CHECKED by dlint's ``protocol`` and
+``protocol-manifest`` checks (analysis/protocol_check.py, scoped to this
+file): every ``OP_*`` constant pairs with exactly one ``send_*`` encoder
+AND one ``worker_loop`` replay arm, packet slot indices stay below
+``ControlPlane.SLOTS``, operand-carrying broadcasts are validated
+pre-broadcast, and fixed header widths (the 7-word fused-prefill header)
+agree between encoder and replay arm. The whole layout — version, op
+table, HEADER/SLOTS, per-op payload counts and header widths — is PINNED
+in ``analysis/protocol.lock``: changing the packet without bumping
+``PROTOCOL_VERSION`` in the same diff fails ``make lint``; after a bump,
+re-pin with ``dlint --update-protocol-manifest`` (and eyeball
+``make protocol``, which prints the extracted op table + manifest diff).
 """
 
 from __future__ import annotations
@@ -522,8 +535,12 @@ class RootControlEngine:
         return handle
 
     def grammar_detach(self, key: str) -> None:
-        self._plane.send_grammar(str(key).encode(), detach=True)
+        """Detach on a pod: root-side release FIRST — the slab detach is
+        host-only bookkeeping (no collective to keep in lockstep), so a
+        key the engine rejects dies with zero packets on the wire (the
+        pod-deadlock rule; dlint's ``protocol`` check pins the order)."""
         self._engine.grammar_detach(key)
+        self._plane.send_grammar(str(key).encode(), detach=True)
 
     def prefill_chunk(
         self, lane: int, chunk, start_pos: int,
@@ -591,9 +608,28 @@ class RootControlEngine:
             np.zeros(n, np.uint32) if seeds is None else np.asarray(seeds, np.uint32),
         )
 
+    def _check_lane_vectors(self, *vecs) -> None:
+        """Pre-broadcast shape validation for the per-lane packet vectors
+        of the plain decode-family ops (the pipelined/fused families run
+        the engine's own ``check_*_dispatch`` set): a ragged or mis-sized
+        vector must die with ZERO packets out, not in the root's engine
+        call with every worker already inside the collective — the
+        pod-deadlock rule, machine-checked by dlint's ``protocol``
+        check."""
+        n = self._engine.n_lanes
+        for v in vecs:
+            if v is not None and len(v) != n:
+                raise ValueError(
+                    f"per-lane packet vector of length {len(v)} != "
+                    f"n_lanes {n}: decode-family packets carry exactly "
+                    "one entry per lane"
+                )
+
     def decode(self, tokens, positions, temps=None, topps=None, seeds=None,
                want_logits: bool = True, g_states=None):
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._check_lane_vectors(tokens, positions, temps, topps, seeds,
+                                 g_states)
         self._plane.send_decode(
             np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
             temps, topps, seeds, want_logits=want_logits,
@@ -781,6 +817,8 @@ class RootControlEngine:
         temps=None, topps=None, seeds=None, g_states=None,
     ):
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._check_lane_vectors(tokens, positions, temps, topps, seeds,
+                                 drafts, draft_len, g_states)
         self._plane.send_decode_spec(
             np.asarray(tokens, np.int32), np.asarray(drafts, np.int32),
             np.asarray(draft_len, np.int32), np.asarray(positions, np.int32),
@@ -796,6 +834,10 @@ class RootControlEngine:
         h: int = 8, g_states=None,
     ):
         temps, topps, seeds = self._normalize_sampling(temps, topps, seeds)
+        self._check_lane_vectors(tokens, positions, temps, topps, seeds,
+                                 g_states)
+        if h < 1:
+            raise ValueError(f"decode_multi horizon h={h} must be >= 1")
         self._plane.send_decode_multi(
             np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
             temps, topps, seeds, h, g_states=g_states,
@@ -826,8 +868,23 @@ class RootControlEngine:
         cache-copy program (the cache is sharded over the global mesh), so
         the operands ride a control packet before the root-side call —
         __getattr__ forwarding alone would desync the workers."""
+        # the engine's own refusals (paged layout, lane bounds via the
+        # cache index) must fire with zero packets out — the pod-deadlock
+        # rule (dlint `protocol`). Paged refusal BEFORE the no-op
+        # short-circuit, matching engine.copy_lane's guard order exactly
+        # (src==dst on a paged engine raises on both surfaces)
+        if getattr(self._engine, "kvpool", None) is not None:
+            raise RuntimeError(
+                "copy_lane is the contiguous layout's primitive; a paged "
+                "engine shares prefix pages by refcount via paged_admit"
+            )
         if src == dst or prefix_len == 0:
             return  # the engine-side short-circuit, BEFORE any packet
+        n = self._engine.n_lanes
+        if not (0 <= src < n and 0 <= dst < n):
+            raise ValueError(
+                f"copy_lane {src} -> {dst} outside lane range [0, {n})"
+            )
         self._plane.send_copy_lane(src, dst)
         self._engine.copy_lane(src, dst)
 
