@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint lockgraph protocol sanitize dryrun chaos fleet clean
+.PHONY: all native test verify lint lockgraph protocol jitcheck hooks sanitize dryrun chaos fleet clean
 
 all: native
 
@@ -97,6 +97,29 @@ lockgraph:
 # `python -m distributed_llama_multiusers_tpu.analysis --update-protocol-manifest`.
 protocol:
 	python -m distributed_llama_multiusers_tpu.analysis --protocol-table
+
+# Compile-stability gate (docs/LINT.md "The runtime recompile witness",
+# ISSUE 15): prints the extracted device-program surface of
+# runtime/engine.py — every compiled step family with its donation
+# spec, dispatchers, and warmup coverage (the reviewer aid for new step
+# families) — then runs the witness suite with DLLAMA_JITCHECK=1: a
+# real serving churn must compile NOTHING after warmup, and a
+# deliberately unwarmed family must make the witness FIRE. (The suite
+# drives both strict and counter-only modes itself via jitcheck.force;
+# its slow subprocess fixture exercises the DLLAMA_JITCHECK=1 env path
+# end to end.) Run it before shipping engine/warmup/dispatch changes;
+# the static checks (jit-stability / donation-discipline /
+# warmup-coverage) ride `make lint`, and the serving pin rides tier-1
+# via `verify`.
+jitcheck:
+	python -m distributed_llama_multiusers_tpu.analysis --jit-table
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_jitcheck.py -q
+
+# Install the git pre-commit hook running the diff-proportional lint
+# (`dlint --changed`, docs/LINT.md) so findings surface at commit time
+# instead of in tier-1. Idempotent; refuses to clobber a foreign hook.
+hooks:
+	sh scripts/install_hooks.sh
 
 # ASan+UBSan gate for the native codec (the reference's sanitizer-CI
 # analogue, SURVEY.md §5.2): rebuilds the .so instrumented and reruns the

@@ -769,6 +769,16 @@ def _phase_serving_churn(config, small):
 
     toks, wall = _run_churn(sched, n_requests, max_tokens)
     stats = engine.stats.snapshot()
+    # compile-stability evidence (ISSUE 15): warmup armed the recompile
+    # witness (analysis/jitcheck.py), so this is the MEASURED count of
+    # XLA compiles the churn paid mid-serving. Assert, not just report:
+    # a phase that recompiled measured warmup latency as serving tok/s,
+    # and the artifact must not bank that silently.
+    assert stats["jit_compiles_after_warmup"] == 0, (
+        f"serving_churn recompiled {stats['jit_compiles_after_warmup']} "
+        "program(s) after warmup — an unwarmed (family, bucket) is back "
+        "(run the suite under DLLAMA_JITCHECK=1 for the guilty stack)"
+    )
 
     # percentiles from the serving histogram registry (TTFT = submit ->
     # first consumed token, observed by the scheduler's telemetry hook)
@@ -840,6 +850,12 @@ def _phase_serving_churn(config, small):
         "serving_churn_admission_stall_s": round(
             stats["admission_stall_s"], 4
         ),
+        # compile stability alongside tok/s (evidence_loop.sh banks this
+        # with every run): 0 = every program the churn dispatched was
+        # compiled at warmup — the asserted invariant above
+        "serving_churn_compiles_after_warmup": stats[
+            "jit_compiles_after_warmup"
+        ],
         "serving_churn_prefix_hits": stats["prefix_hits"],
         **trace_extra,
     }
@@ -1088,7 +1104,17 @@ def _phase_pod_serving(config, small):
     coll = engine.collective_stats()
 
     toks, wall = _run_churn(sched, n_requests, max_tokens)
+    # snapshot BEFORE the sync probe below: the probe is diagnostics and
+    # must not blur the serving window's compile-stability evidence
     stats = engine.stats.snapshot()
+    # the pod twin of serving_churn's compile-stability gate: a recompile
+    # on a mesh stalls EVERY chip of the pod mid-serving, and a phase
+    # that recompiled banked warmup latency as tok/s/chip (the number
+    # ROADMAP item 2 spends real v5e-8 time on)
+    assert stats["jit_compiles_after_warmup"] == 0, (
+        f"pod_serving recompiled {stats['jit_compiles_after_warmup']} "
+        "program(s) after warmup — an unwarmed mesh family is back"
+    )
 
     # measured per-step sync split (profiler probe; rewrites cache slot 0,
     # safe after the workload) — fed into the telemetry histogram so the
@@ -1120,6 +1146,10 @@ def _phase_pod_serving(config, small):
         "pod_serving_pipeline_flushes": stats["pipeline_flushes"],
         "pod_serving_fused_steps": stats["fused_steps"],
         "pod_serving_pipeline_dispatches": stats["pipeline_dispatches"],
+        # compile stability over the measured window (asserted 0 above)
+        "pod_serving_compiles_after_warmup": stats[
+            "jit_compiles_after_warmup"
+        ],
         # static per-step collective payload (post-SPMD HLO) + measured split
         "pod_serving_sync_bytes_per_decode": coll.get("total_bytes", 0),
         "pod_serving_sync_collectives_per_decode": coll.get("n_collectives", 0),

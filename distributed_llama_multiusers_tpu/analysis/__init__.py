@@ -8,7 +8,7 @@ reviewer memory. This package machine-checks them — the Python/JAX
 analogue of the reference repo's sanitizer CI for C++ (SURVEY.md §5.2,
 mirrored by ``make sanitize``).
 
-Thirteen checks (docs/LINT.md has the full contract and waiver policy).
+Sixteen checks (docs/LINT.md has the full contract and waiver policy).
 The four ``lock-*``/``pod-*`` checks are the v2 cross-file concurrency
 layer: they share one lock model (lockgraph.py) of every class-qualified
 lock in the package, and the statically computed lock-order graph doubles
@@ -16,7 +16,12 @@ as the runtime witness's seed (lockcheck.py, ``DLLAMA_LOCKCHECK=1``).
 The ``protocol*``/``replay-determinism`` checks are the v3 wire-protocol
 layer: a surface model of ``parallel/multihost.py`` (protocol_check.py)
 pinned by ``analysis/protocol.lock``, plus a declared determinism scope
-over the journal/recovery/migration/grammar replay closure.
+over the journal/recovery/migration/grammar replay closure. The
+``jit-*``/``donation-*``/``warmup-*`` checks are the v4 compile-
+stability layer: a device-program surface model of ``runtime/engine.py``
+(jitmodel.py — every ``jax.jit`` site, step-family binding, dispatcher,
+and what ``warmup_engine`` warms), paired with the runtime recompile
+witness (jitcheck.py, ``DLLAMA_JITCHECK=1``).
 
 - ``lock-order``     — the cross-file "held while acquiring" graph over
   declared locks stays acyclic (one level of intra-package calls
@@ -38,6 +43,13 @@ over the journal/recovery/migration/grammar replay closure.
 - ``replay-determinism`` — no unjournaled entropy, builtin ``hash()``,
   or set-iteration ordering inside the journal/recovery/migration/
   grammar replay scope
+- ``jit-stability`` — device-pytree leaves stored into engine state
+  come from the sanctioned sharding-preserving constructor
+  (``_replace_leaf``), never a bare ``jnp.asarray``
+- ``donation-discipline`` — every ``donate_argnums`` call site rebinds
+  the donated operand from the call's results; no use-after-donate
+- ``warmup-coverage`` — every dispatchable compiled step family is
+  warmed by ``warmup_engine``, bucketed families per prefill bucket
 - ``host-sync``      — explicit, waived device->host transfers in decode
 - ``pipeline-sync``  — NO host syncs at all in the async-pipeline dispatch
   half (engine.decode_pipelined / scheduler._pipeline_dispatch)

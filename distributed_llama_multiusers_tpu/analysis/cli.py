@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
         "diff vs the pinned manifest, and exit — the reviewer aid for "
         "packet-layout changes (`make protocol`)",
     )
+    ap.add_argument(
+        "--jit-table", action="store_true",
+        help="print the extracted device-program surface of "
+        "runtime/engine.py — every compiled step family with its "
+        "donation spec, dispatchers, and warmup coverage — and exit; "
+        "the reviewer aid for new step families (`make jitcheck`)",
+    )
     return ap
 
 
@@ -209,6 +216,62 @@ def _protocol_table(paths: list[Path]) -> int:
     return 0
 
 
+def _find_engine(paths: list[Path]) -> Path | None:
+    for p in iter_py_files(paths):
+        if p.as_posix().endswith("runtime/engine.py"):
+            return p
+    return None
+
+
+def _jit_table(paths: list[Path]) -> int:
+    from .jitmodel import jit_model_of
+
+    target = _find_engine(paths)
+    if target is None:
+        print("dlint: no runtime/engine.py under the given paths",
+              file=sys.stderr)
+        return 2
+    model = jit_model_of(target)
+    warmed_fams = model.warmed_families()
+    n_fam = len({id(s) for s in model.families.values()})
+    print(f"jit surface: {len(model.sites)} jax.jit site(s), "
+          f"{n_fam} step families  ({target})")
+    print(f"{'family':26s} {'line':>5s} {'donate':8s} "
+          f"{'dispatched by':34s} warmed")
+    seen: set[int] = set()
+    for attr, site in sorted(model.families.items(),
+                             key=lambda kv: model.family_lines[kv[1].name]
+                             if kv[1].name in model.family_lines
+                             else kv[1].line):
+        if id(site) in seen:
+            continue
+        seen.add(id(site))
+        dispatchers = sorted(
+            d.name + ("[b]" if d.bucketed else "")
+            for d in model.dispatchers.values()
+            if any(a in d.families for a, s in model.families.items()
+                   if s is site)
+        )
+        warm = any(
+            a in warmed_fams for a, s in model.families.items() if s is site
+        )
+        donate = ",".join(map(str, site.donate)) or "-"
+        print(f"{attr:26s} {site.line:5d} {donate:8s} "
+              f"{', '.join(dispatchers) or '— NONE —':34s} "
+              f"{'yes' if warm else 'NO'}")
+    if model.has_warmup:
+        calls = ", ".join(
+            m + ("[bucketed]" if c.in_bucket_loop else "")
+            for m, c in sorted(model.warmed.items())
+        )
+        print(f"\nwarmup_engine (line {model.warmup_line}) warms: {calls}")
+    else:
+        print("\nwarmup_engine: MISSING")
+    print("([b] = compiles per prefill bucket; the runtime twin is "
+          f"DLLAMA_JITCHECK=1 — docs/LINT.md)")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     checkers = default_checkers()
@@ -233,6 +296,8 @@ def main(argv=None) -> int:
         return 0
     if args.protocol_table:
         return _protocol_table(paths)
+    if args.jit_table:
+        return _jit_table(paths)
     analyzer = Analyzer(checkers)
     if args.graph:
         model = scan_paths(paths, valid_checks=analyzer.valid_checks)

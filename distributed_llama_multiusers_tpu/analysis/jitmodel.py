@@ -1,0 +1,503 @@
+"""Device-program (``jax.jit``) surface model, extracted from the AST.
+
+The serving loop's compile-stability family of invariants — *one
+compiled program per (family, bucket), compiled only at warmup* — was
+enforced by comments and hand review until PR 15: PR 11's review found
+by hand that a bare ``jnp.asarray`` table-leaf replacement changed the
+compiled programs' input aval and forced a recompile per admission, and
+the same PR had to hot-fix a missed warmup (the COW page-copy program
+compiled mid-chain on the first divergent-block admission). This module
+mechanizes the surface those audits re-derived every time, the way
+``protocol_check.extract_protocol`` models the pod wire protocol:
+
+- every ``jax.jit`` site (decorated closure, inline ``jax.jit(...)``
+  assignment, immediately-invoked init-time jit, jit-returning factory)
+  with its ``donate_argnums`` / ``static_argnames``;
+- the **step families**: ``self.<attr>`` bindings of those sites on the
+  engine class (``_decode_fn``, ``_copy_page_fn``, ``_sample_one``, the
+  ``_decode_multi_fns`` factory dict, …);
+- the **dispatchers**: public engine methods that call a family
+  (through direct attribute calls, local aliases, conditional
+  expressions, and ``fn(*operands)`` tuple expansion), plus whether the
+  dispatch pads its operand through ``self.bucket_for`` (bucketed
+  families compile once per prefill bucket);
+- what ``warmup_engine`` actually warms: the engine methods it calls
+  (``getattr(engine, "name")`` aliases included) and whether each call
+  sits inside the ``for ... in engine.prefill_buckets`` loop.
+
+Three checkers (``jit_surface_check.py``) consume the model; the
+runtime recompile witness (``jitcheck.py``, ``DLLAMA_JITCHECK=1``)
+proves at runtime what this model proves statically. Pure stdlib
+``ast`` — no jax import, like the rest of the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .lockgraph import walk_excluding_nested_defs
+
+# call spellings that create a compiled program
+JIT_SPELLINGS = ("jax.jit",)
+PARTIAL_SPELLINGS = ("partial", "functools.partial")
+WARMUP_FN = "warmup_engine"
+BUCKET_ITER_SUFFIX = ".prefill_buckets"
+
+
+@dataclass
+class JitSite:
+    """One ``jax.jit`` occurrence."""
+
+    name: str  # def name, or the bound attr for inline jax.jit(...) forms
+    line: int
+    donate: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    # "family": dispatched at serving time; "init": immediately invoked
+    # at construction (compiles before warmup by construction); "free":
+    # a module-level jit nothing binds to an engine attr
+    kind: str = "family"
+    factory: str | None = None  # enclosing jit-returning factory, if any
+
+
+@dataclass
+class Dispatcher:
+    """An engine method that dispatches compiled families."""
+
+    name: str
+    line: int
+    families: set[str] = field(default_factory=set)  # family attrs called
+    bucketed: bool = False  # pads a host operand via self.bucket_for(...)
+    # one DonateUse per donated argument of each donating call — the
+    # donation-discipline checker's raw material
+    donate_calls: list["DonateUse"] = field(default_factory=list)
+
+
+@dataclass
+class DonateUse:
+    """One donated argument at one call site, with the facts the
+    donation-discipline check needs: was the donated expression rebound
+    by the call's own assignment targets, is it read again afterwards,
+    did it escape into host-side state before the call."""
+
+    family: str
+    line: int  # the call
+    spelling: str  # the donated argument, as spelled (`self.cache`)
+    rebound: bool  # appears among the call statement's assignment targets
+    later_read_line: int | None = None  # first Load after the call
+    escape_line: int | None = None  # stored into other self-state pre-call
+
+
+@dataclass
+class WarmupCall:
+    method: str
+    line: int
+    in_bucket_loop: bool = False
+
+
+@dataclass
+class JitModel:
+    display: str
+    sites: list[JitSite] = field(default_factory=list)
+    # engine-attr -> the jit site it binds ("_decode_fn" -> _decode)
+    families: dict[str, JitSite] = field(default_factory=dict)
+    family_lines: dict[str, int] = field(default_factory=dict)
+    dispatchers: dict[str, Dispatcher] = field(default_factory=dict)
+    has_warmup: bool = False
+    warmup_line: int = 0
+    warmed: dict[str, WarmupCall] = field(default_factory=dict)
+
+    def warmed_families(self) -> set[str]:
+        """Family attrs reachable from a method ``warmup_engine`` calls."""
+        out: set[str] = set()
+        for m in self.warmed:
+            d = self.dispatchers.get(m)
+            if d is not None:
+                out |= d.families
+        return out
+
+
+def _spelled(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on parsed trees
+        return ""
+
+
+def _int_tuple(node: ast.AST) -> tuple[int, ...]:
+    """``(1,)`` / ``(0, 1)`` / ``1`` keyword values -> ints."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node: ast.AST) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _jit_decorator_site(fn) -> JitSite | None:
+    """``@partial(jax.jit, donate_argnums=(1,))`` / ``@jax.jit``."""
+    for dec in fn.decorator_list:
+        if _spelled(dec) in JIT_SPELLINGS:
+            return JitSite(fn.name, fn.lineno)
+        if isinstance(dec, ast.Call) and _spelled(dec.func) in PARTIAL_SPELLINGS \
+                and dec.args and _spelled(dec.args[0]) in JIT_SPELLINGS:
+            donate: tuple[int, ...] = ()
+            statics: tuple[str, ...] = ()
+            for kw in dec.keywords:
+                if kw.arg == "donate_argnums":
+                    donate = _int_tuple(kw.value)
+                elif kw.arg == "static_argnames":
+                    statics = _str_tuple(kw.value)
+            return JitSite(fn.name, fn.lineno, donate=donate,
+                           static_argnames=statics)
+    return None
+
+
+def _jit_call_site(value: ast.AST, bound_name: str) -> JitSite | None:
+    """``jax.jit(...)`` / ``jax.jit(...)()`` on an assignment's value."""
+    if isinstance(value, ast.Call) and _spelled(value.func) in JIT_SPELLINGS:
+        donate: tuple[int, ...] = ()
+        for kw in value.keywords:
+            if kw.arg == "donate_argnums":
+                donate = _int_tuple(kw.value)
+        return JitSite(bound_name, value.lineno, donate=donate)
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Call) \
+            and _spelled(value.func.func) in JIT_SPELLINGS:
+        # immediately invoked: jax.jit(init_fn, ...)() — compiles at
+        # construction time, never dispatched again
+        return JitSite(bound_name, value.lineno, kind="init")
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _Extractor(ast.NodeVisitor):
+    def __init__(self, model: JitModel):
+        self.model = model
+        # def name -> site, for binding `self._x = _decode`
+        self.sites_by_name: dict[str, JitSite] = {}
+        # factory name -> the inner jit site it returns
+        self.factories: dict[str, JitSite] = {}
+
+    # -- pass 1: every jit site + factory ---------------------------------
+
+    def collect_sites(self, tree: ast.Module) -> None:
+        stack: list[ast.AST] = []
+
+        def rec(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                site = _jit_decorator_site(node)
+                if site is not None:
+                    self.model.sites.append(site)
+                    self.sites_by_name[site.name] = site
+                    # a jit-returning factory: the nearest enclosing def
+                    # that returns this jit by name
+                    for outer in reversed(stack):
+                        if isinstance(outer, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)):
+                            if self._returns_name(outer, site.name):
+                                site.factory = outer.name
+                                self.factories[outer.name] = site
+                            break
+            elif isinstance(node, ast.Assign):
+                targets = [t for t in node.targets]
+                bound = None
+                for t in targets:
+                    a = _self_attr(t)
+                    if a is not None:
+                        bound = a
+                        break
+                    if isinstance(t, ast.Name):
+                        bound = bound or t.id
+                site = _jit_call_site(node.value, bound or "<anon>")
+                if site is not None:
+                    self.model.sites.append(site)
+                    if bound is not None:
+                        self.sites_by_name.setdefault(bound, site)
+            stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                rec(child)
+            stack.pop()
+
+        rec(tree)
+
+    @staticmethod
+    def _returns_name(fn, name: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and isinstance(node.value, ast.Name) \
+                    and node.value.id == name:
+                return True
+        return False
+
+    # -- pass 2: family attr bindings --------------------------------------
+
+    def collect_families(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            site = self._site_of_value(node.value)
+            if site is None or site.kind == "init":
+                # init-kind jits compile at construction, before warmup
+                # by construction — not dispatchable families
+                continue
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is None and isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)  # self._decode_multi_fns[h]
+                if attr is not None and attr not in self.model.families:
+                    self.model.families[attr] = site
+                    self.model.family_lines[attr] = node.lineno
+
+    def _site_of_value(self, value: ast.AST) -> JitSite | None:
+        if isinstance(value, ast.Name):
+            if value.id in self.factories:
+                return self.factories[value.id]
+            return self.sites_by_name.get(value.id)
+        if isinstance(value, ast.Call):
+            spelled = _spelled(value.func)
+            if spelled in JIT_SPELLINGS:
+                # direct `self.x = jax.jit(...)` — the site was recorded
+                # under the bound attr in pass 1; look it up by line
+                for s in self.model.sites:
+                    if s.line == value.lineno:
+                        return s
+            if isinstance(value.func, ast.Name) \
+                    and value.func.id in self.factories:
+                return self.factories[value.func.id]
+            attr = _self_attr(value.func)
+            if attr is not None and attr in self.model.families:
+                return self.model.families[attr]
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Call) \
+                and _spelled(value.func.func) in JIT_SPELLINGS:
+            for s in self.model.sites:
+                if s.kind == "init" and s.line == value.lineno:
+                    return s
+        return None
+
+    # -- pass 3: dispatchers ------------------------------------------------
+
+    def collect_dispatchers(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for fn in node.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if fn.name == "__init__":
+                    continue
+                d = self._scan_method(fn)
+                if d.families or d.bucketed:
+                    # methods may repeat across fixture classes; first wins
+                    self.model.dispatchers.setdefault(fn.name, d)
+
+    def _family_of_expr(self, expr: ast.AST,
+                        aliases: dict[str, str]) -> str | None:
+        """Resolve an expression to the family attr it denotes: direct
+        ``self.X``, either branch of a conditional (``self._decode_exec
+        if ... else self._decode_fn``), ``self.X[...]`` / ``self.X.get``
+        dict lookups, factory calls ``self.X(...)``, local aliases."""
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.model.families:
+            return attr
+        if isinstance(expr, ast.Name):
+            return aliases.get(expr.id)
+        if isinstance(expr, ast.IfExp):
+            return (self._family_of_expr(expr.body, aliases)
+                    or self._family_of_expr(expr.orelse, aliases))
+        if isinstance(expr, ast.Subscript):
+            return self._family_of_expr(expr.value, aliases)
+        if isinstance(expr, ast.Call):
+            if isinstance(expr.func, ast.Attribute) \
+                    and expr.func.attr == "get":
+                return self._family_of_expr(expr.func.value, aliases)
+            return self._family_of_expr(expr.func, aliases)
+        return None
+
+    def _scan_method(self, fn) -> Dispatcher:
+        d = Dispatcher(fn.name, fn.lineno)
+        aliases: dict[str, str] = {}
+        tuples: dict[str, list[ast.AST]] = {}  # operand-tuple literals
+        # alias/tuple collection first (lexical order is good enough: the
+        # engine's aliases are assigned before use)
+        for node in walk_excluding_nested_defs(fn):
+            if isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Tuple):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tuples[t.id] = list(node.value.elts)
+                fam = self._family_of_expr(node.value, aliases)
+                if fam is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            aliases[t.id] = fam
+        for node in walk_excluding_nested_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _self_attr(node.func) == "bucket_for" or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "bucket_for"
+            ):
+                d.bucketed = True
+                continue
+            fam = None
+            attr = _self_attr(node.func)
+            if attr is not None and attr in self.model.families:
+                fam = attr
+            elif isinstance(node.func, ast.Name):
+                fam = aliases.get(node.func.id)
+            if fam is None:
+                continue
+            d.families.add(fam)
+            site = self.model.families[fam]
+            if site.donate:
+                d.donate_calls.extend(
+                    self._donate_uses(fn, node, fam, site, tuples)
+                )
+        return d
+
+    def _donate_uses(self, fn, call: ast.Call, fam: str, site: JitSite,
+                     tuples: dict[str, list[ast.AST]]) -> list[DonateUse]:
+        args = list(call.args)
+        if len(args) == 1 and isinstance(args[0], ast.Starred) \
+                and isinstance(args[0].value, ast.Name):
+            # fn(*operands) with `operands = (a, b, ...)` assigned in the
+            # same function: substitute the tuple literal's elements
+            args = tuples.get(args[0].value.id, args)
+        donated: list[str] = []
+        for i in site.donate:
+            if i < len(args) and not isinstance(args[i], ast.Starred):
+                donated.append(_spelled(args[i]))
+        targets: list[str] = []
+        for node in walk_excluding_nested_defs(fn):
+            if isinstance(node, ast.Assign) and any(
+                c is call for c in ast.walk(node.value)
+            ):
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        targets.extend(_spelled(e) for e in t.elts)
+                    else:
+                        targets.append(_spelled(t))
+        # the donated buffer is invalid once the call is dispatched; a
+        # read past the call's own statement (end_lineno: operand lists
+        # span lines) reads freed memory unless the same spelling was
+        # rebound from the call result
+        after = getattr(call, "end_lineno", call.lineno) or call.lineno
+        out = []
+        for s in donated:
+            use = DonateUse(fam, call.lineno, s, rebound=s in targets)
+            if not use.rebound:
+                for node in walk_excluding_nested_defs(fn):
+                    if isinstance(node, (ast.Name, ast.Attribute)) \
+                            and isinstance(getattr(node, "ctx", None), ast.Load) \
+                            and _spelled(node) == s \
+                            and getattr(node, "lineno", 0) > after:
+                        line = node.lineno
+                        if use.later_read_line is None \
+                                or line < use.later_read_line:
+                            use.later_read_line = line
+            for node in walk_excluding_nested_defs(fn):
+                if isinstance(node, ast.Assign) \
+                        and getattr(node, "lineno", 0) < call.lineno \
+                        and _spelled(node.value) == s:
+                    for t in node.targets:
+                        if _self_attr(t) is not None and _spelled(t) != s:
+                            use.escape_line = node.lineno
+            out.append(use)
+        return out
+
+    # -- pass 4: warmup coverage --------------------------------------------
+
+    def collect_warmup(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == WARMUP_FN:
+                self.model.has_warmup = True
+                self.model.warmup_line = node.lineno
+                self._scan_warmup(node)
+                return
+
+    def _scan_warmup(self, fn) -> None:
+        if not fn.args.args:
+            return
+        engine = fn.args.args[0].arg
+        aliases: dict[str, str] = {}
+        for node in walk_excluding_nested_defs(fn):
+            # alias = getattr(engine, "method", default)
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name) \
+                    and node.value.func.id == "getattr" \
+                    and len(node.value.args) >= 2 \
+                    and isinstance(node.value.args[0], ast.Name) \
+                    and node.value.args[0].id == engine \
+                    and isinstance(node.value.args[1], ast.Constant) \
+                    and isinstance(node.value.args[1].value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases[t.id] = node.value.args[1].value
+        # bucket-loop membership needs ancestry
+        bucket_lines: set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For) \
+                    and _spelled(node.iter).endswith(BUCKET_ITER_SUFFIX):
+                for sub in ast.walk(node):
+                    line = getattr(sub, "lineno", None)
+                    if line is not None:
+                        bucket_lines.add(line)
+        for node in walk_excluding_nested_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            method = None
+            if isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == engine:
+                method = node.func.attr
+            elif isinstance(node.func, ast.Name) and node.func.id in aliases:
+                method = aliases[node.func.id]
+            if method is None:
+                continue
+            call = WarmupCall(method, node.lineno,
+                              in_bucket_loop=node.lineno in bucket_lines)
+            prev = self.model.warmed.get(method)
+            if prev is None or (call.in_bucket_loop and not prev.in_bucket_loop):
+                self.model.warmed[method] = call
+
+
+def extract_jit_model(tree: ast.Module, display: str) -> JitModel:
+    """Build the surface model for one file. Empty model (no sites) when
+    the file compiles nothing — the checkers gate on that."""
+    model = JitModel(display)
+    ex = _Extractor(model)
+    ex.collect_sites(tree)
+    ex.collect_families(tree)
+    ex.collect_dispatchers(tree)
+    ex.collect_warmup(tree)
+    return model
+
+
+def jit_model_of(path: Path | str) -> JitModel:
+    """The model for a real file on disk (rot-guard tests, --jit-table)."""
+    p = Path(path)
+    return extract_jit_model(
+        ast.parse(p.read_text(encoding="utf-8")), p.as_posix()
+    )

@@ -1,4 +1,4 @@
-"""Checker registry: the thirteen project-invariant checks, in report order.
+"""Checker registry: the sixteen project-invariant checks, in report order.
 
 Order matters for collection: the lock-order checker's collect pass
 builds the shared cross-file lock model (``project.lock_model``) that
@@ -16,6 +16,11 @@ from .condvar_check import CondvarChecker
 from .core import Checker
 from .determinism_check import ReplayDeterminismChecker
 from .host_sync_check import HostSyncChecker
+from .jit_surface_check import (
+    DonationDisciplineChecker,
+    JitStabilityChecker,
+    WarmupCoverageChecker,
+)
 from .lock_atomicity_check import LockAtomicityChecker
 from .lock_blocking_check import LockBlockingChecker
 from .lock_check import GuardedByChecker
@@ -33,6 +38,9 @@ ALL_CHECKERS = (
     ProtocolChecker,
     ProtocolManifestChecker,
     ReplayDeterminismChecker,
+    JitStabilityChecker,
+    DonationDisciplineChecker,
+    WarmupCoverageChecker,
     HostSyncChecker,
     PipelineSyncChecker,
     ClockChecker,

@@ -30,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import jitcheck
 from ..grammar.slab import (
     DEFAULT_SLAB_EDGES,
     DEFAULT_SLAB_STATES,
@@ -139,6 +140,15 @@ class EngineStats:
     # the ones where it actually bit)
     grammar_lanes: int = 0
     grammar_masked_steps: int = 0
+    # compile stability (analysis/jitcheck.py, ISSUE 15): XLA backend
+    # compiles observed AFTER warmup_engine armed the recompile witness —
+    # the machine-checked form of "one compiled program per (family,
+    # bucket), compiled only at warmup". Must read 0 in steady serving;
+    # any bump means an unwarmed family or an aval-changing operand
+    # rebuild stalled every lane mid-service. NOT cleared by reset():
+    # like sync_bytes_per_decode it describes the process since warmup,
+    # not a stats window — a window reset must not hide a recompile.
+    jit_compiles_after_warmup: int = 0
     # writers (engine hot paths, scheduler counters) hold this around their
     # multi-field bumps; snapshot()/reset() hold it while copying, so a
     # /stats read sees one consistent point in time instead of field-by-field
@@ -167,6 +177,7 @@ class EngineStats:
             "sync_bytes_per_decode", "sync_collectives_per_decode",
             "sync_bytes_total", "worker_restarts", "worker_replay_errors",
             "grammar_lanes", "grammar_masked_steps",
+            "jit_compiles_after_warmup",
         ),
     }
 
@@ -203,7 +214,9 @@ class EngineStats:
             self.worker_restarts = self.worker_replay_errors = 0
             self.grammar_lanes = self.grammar_masked_steps = 0
             # per-decode sync_* stay: they describe the compiled program,
-            # not a window
+            # not a window; jit_compiles_after_warmup stays: it describes
+            # compile stability since warmup, and a window reset hiding a
+            # mid-serving recompile would defeat the witness
         return snap
 
     def preserved(self):
@@ -234,7 +247,6 @@ class InferenceEngine:
         emulate_q80_activations: bool = False,
         mesh=None,
         replicate_outputs: bool = False,
-        device_topk: int = 64,
         q80_sync: bool = False,
         pipeline_depth: int | None = None,
         paged_kv: bool = False,
@@ -318,11 +330,12 @@ class InferenceEngine:
                 self.cache = jax.jit(
                     init_fn, out_shardings=shardings
                 )()
-                # every table replacement must carry this sharding: a
-                # bare jnp.asarray leaf would change the compiled
-                # programs' input aval (recompile per admission on a
-                # single-host mesh; incompatible-devices failure on a
-                # multi-process pod)
+                # every table replacement must carry this sharding (see
+                # _replace_leaf, THE sanctioned constructor): a bare
+                # jnp.asarray leaf would change the compiled programs'
+                # input aval (recompile per admission on a single-host
+                # mesh; incompatible-devices failure on a multi-process
+                # pod) — machine-checked by dlint's jit-stability
                 self._table_sharding = shardings.table
             else:
                 self.cache = init_fn()
@@ -342,7 +355,6 @@ class InferenceEngine:
             self.kvpool = None
             self.cache = init_kv_cache(config, n_lanes, dtype=cache_dtype)
         self.stats = EngineStats()
-        self.device_topk = min(device_topk, config.vocab_size)
         # async decode pipeline: bounded ring of dispatched-but-unconsumed
         # steps plus the on-device token carry feeding the next dispatch
         self.pipeline_depth = (
@@ -496,9 +508,9 @@ class InferenceEngine:
         # vocab (top_k with k == vocab_size is a total descending sort), so
         # no truncation class exists and wide-nucleus / high-temperature
         # requests sample on device like everyone else — the host Sampler
-        # survives only as the host_sampling=True escape hatch.
-        # (device_topk is kept as a constructor knob for API compatibility
-        # but no longer truncates sampling.)
+        # survives only as the host_sampling=True escape hatch. (PR 9's
+        # dead `device_topk` knob is gone: a knob that selects no program
+        # is exactly what the warmup-coverage lint would mis-model.)
         nucleus_k = cfg.vocab_size
 
         def _sample_lane(row, temp, topp, seed, pos, greedy):
@@ -1133,23 +1145,14 @@ class InferenceEngine:
     def _gtab(self):
         """The slab's device copies, re-uploaded only when the slab
         version moved (a new schema installed / an entry evicted) —
-        shapes are capacity-fixed, so this is never a recompile."""
+        shapes are capacity-fixed and the leaves go through
+        ``_replace_leaf``, so this is never a recompile."""
         if self._g_version != self.grammar_slab.version:
             masks, ek, en, dflt = self.grammar_slab.arrays()
-            if self._g_sharding is None:
-                self._g_dev = tuple(
-                    jnp.asarray(a) for a in (masks, ek, en, dflt)
-                )
-            else:
-                # multi-process pods: build the replicated leaves from
-                # each process's (identical) host mirror, like _table_leaf
-                self._g_dev = tuple(
-                    jax.make_array_from_callback(
-                        a.shape, self._g_sharding,
-                        lambda idx, a=a: a[idx],
-                    )
-                    for a in (masks, ek, en, dflt)
-                )
+            self._g_dev = tuple(
+                self._replace_leaf(a, self._g_sharding)
+                for a in (masks, ek, en, dflt)
+            )
             self._g_version = self.grammar_slab.version
         return self._g_dev
 
@@ -2065,18 +2068,34 @@ class InferenceEngine:
         # dlint: ok[host-sync] host int list -> numpy row; no device value involved
         return np.asarray(self.kvpool.table_row(list(blocks)), np.int32)
 
-    def _table_leaf(self):
-        """The host table mirror as the cache pytree's table leaf. On a
-        mesh the leaf must carry the SAME replicated NamedSharding the
-        cache was initialized with — make_array_from_callback builds it
-        from each process's (identical) host mirror, so it works on
-        multi-process pods where the mesh is not fully addressable."""
-        if self._table_sharding is None:
-            return jnp.asarray(self._host_tables)
+    def _replace_leaf(self, host_array, sharding):
+        """THE sanctioned device-leaf constructor — the ``engine.py``
+        aval-stability rule promoted from a comment into code (PR 11's
+        review found the failure by hand; dlint's ``jit-stability``
+        check now whitelists exactly this function). Every device-pytree
+        leaf rebuilt between dispatches (the page-table row, the grammar
+        slab tables) MUST come through here:
+
+        - off-mesh (``sharding is None``): a plain ``jnp.asarray`` of
+          the host mirror — same shape/dtype, so the leaf's aval is
+          unchanged by construction;
+        - on a mesh: ``make_array_from_callback`` with the NamedSharding
+          captured at init, built from each process's (identical) host
+          mirror — the ONLY form that both preserves the compiled
+          programs' input aval (a bare ``jnp.asarray`` would drop the
+          sharding and force a recompile per replacement on a
+          single-host mesh) and works on multi-process pods where the
+          mesh is not fully addressable."""
+        if sharding is None:
+            return jnp.asarray(host_array)
         return jax.make_array_from_callback(
-            self._host_tables.shape, self._table_sharding,
-            lambda idx: self._host_tables[idx],
+            host_array.shape, sharding, lambda idx: host_array[idx]
         )
+
+    def _table_leaf(self):
+        """The host table mirror as the cache pytree's table leaf, via
+        the sanctioned sharding-preserving constructor."""
+        return self._replace_leaf(self._host_tables, self._table_sharding)
 
     def apply_paged_admit(self, lane: int, row, copies) -> None:
         """Device half of a paged admission (or release): apply the COW
@@ -2171,7 +2190,11 @@ def warmup_engine(
     restored afterwards."""
     n = engine.n_lanes
     z = np.zeros(n, np.int32)
-    with engine.stats.preserved():
+    # warmup's own compiles are the sanctioned ones: pause the recompile
+    # witness for the duration (tests warm several engines per process —
+    # one engine's warmup must not fire another's armed witness); arming
+    # for THIS engine happens at the end, once every program is compiled
+    with jitcheck.warming(), engine.stats.preserved():
         for bucket in engine.prefill_buckets:
             engine.prefill_chunk(0, [0] * bucket, 0)
         engine.decode(z, z)
@@ -2198,35 +2221,63 @@ def warmup_engine(
             and getattr(engine, "supports_pipelined", False)
             and getattr(engine, "pipeline_depth", 0) > 1
         ):
+            # each pipelined family is warmed TWICE: the reseed form
+            # (host-array feed/positions) and the CHAINED form
+            # (positions -1 = read the device carry). On a mesh these
+            # are DIFFERENT compiled programs — the chained dispatch's
+            # feed/carry operands arrive with the replicated
+            # NamedSharding the previous step produced, not host
+            # arrays — so warming only the reseed left the first live
+            # chained step of every pod serving loop paying an XLA
+            # compile mid-service (found by the DLLAMA_JITCHECK witness
+            # on the virtual pod; single-chip engines hit one program
+            # for both forms). The ring is depth >= 2 here, so the
+            # chained dispatch fits before the flush.
+            neg = np.full(n, -1, np.int32)
             engine.decode_pipelined(z, tokens=z)
+            engine.decode_pipelined(neg)
             engine.pipeline_flush()
             spec_pl = bool(
                 spec and getattr(engine, "supports_spec_pipelined", False)
             )
             if spec_pl:
                 # the in-chain spec verify step: the first draft hit in a
-                # live chain must not eat an XLA compile
+                # live chain must not eat an XLA compile — reseed AND
+                # chained forms, like the plain pipelined step
                 k1 = engine.SPEC_DRAFT + 1
                 engine.decode_spec_pipelined(
                     z, np.zeros((n, k1), np.int32), z, tokens=z
+                )
+                engine.decode_spec_pipelined(
+                    neg, np.zeros((n, k1), np.int32), z
                 )
                 engine.pipeline_flush()
             if getattr(engine, "supports_fused_prefill", False):
                 # the fused prefill+decode family compiles per bucket —
                 # without this, the FIRST admission into a live chain
-                # pays a fresh XLA compile exactly when lanes are hot
+                # pays a fresh XLA compile exactly when lanes are hot.
+                # Admissions ride the LIVE chain by design, so the
+                # chained form is the one serving actually dispatches —
+                # warm it behind each bucket's reseed form.
                 park = np.full(n, engine.config.seq_len, np.int32)
                 for bucket in engine.prefill_buckets:
                     engine.decode_prefill_fused(
                         park, p_lane=0, chunk=[0] * bucket, tokens=z,
                     )
+                    engine.decode_prefill_fused(
+                        neg, p_lane=0, chunk=[0] * bucket,
+                    )
                     engine.pipeline_flush()
                     if spec_pl:
                         # admitting chunk + spec verify sharing a dispatch
-                        # compiles per bucket too
+                        # compiles per bucket too — both forms again
                         engine.decode_spec_prefill_fused(
                             park, np.zeros((n, k1), np.int32), z,
                             p_lane=0, chunk=[0] * bucket, tokens=z,
+                        )
+                        engine.decode_spec_prefill_fused(
+                            neg, np.zeros((n, k1), np.int32), z,
+                            p_lane=0, chunk=[0] * bucket,
                         )
                         engine.pipeline_flush()
         pool = getattr(engine, "kvpool", None)
@@ -2243,6 +2294,19 @@ def warmup_engine(
                 np.full(pool.blocks_per_lane, pool.n_pages, np.int32),
                 [(0, 0)],
             )
+        if pool is None and n > 1:
+            # the contiguous prefix-reuse primitive (found by dlint's
+            # warmup-coverage at adoption): the first shared-prefix
+            # admission used to pay the whole-lane-copy compile
+            # mid-serving. Traced src/dst scalars: ONE program for any
+            # pair; lane 1's junk is rewritten by its next admission.
+            engine.copy_lane(0, 1)
+        # the host-exact escape hatch's standalone sampler (same
+        # adoption finding): one [vocab] program, pennies to warm
+        engine.sample_token(
+            np.zeros(engine.config.vocab_size, np.float32),
+            0.7, 0.9, 1, 0,
+        )
     # pod roots: drop the replayed warmup traffic from worker counters too
     reset_workers = getattr(engine, "reset_worker_stats", None)
     if reset_workers is not None:
@@ -2257,10 +2321,18 @@ def warmup_engine(
     if mesh is not None:
         coll = getattr(engine, "collective_stats", None)
         if callable(coll):
-            try:
-                coll()
-            except Exception:  # the probe is evidence, never a startup blocker
-                pass
+            with jitcheck.warming():
+                try:
+                    coll()
+                except Exception:  # the probe is evidence, never a startup blocker
+                    pass
+    # from here on a new XLA backend compile is a broken invariant: every
+    # one bumps stats.jit_compiles_after_warmup (surfaced on /stats,
+    # bridged to /metrics, banked by the bench phases), and under
+    # DLLAMA_JITCHECK=1 raises RecompileAfterWarmup at the guilty
+    # dispatch — the runtime twin of the warmup-coverage/jit-stability
+    # static checks (analysis/jitcheck.py, docs/LINT.md)
+    jitcheck.arm(engine.stats)
     pipelined = bool(
         pipeline
         and getattr(engine, "supports_pipelined", False)
@@ -2292,5 +2364,8 @@ def warmup_engine(
             and spec
             and getattr(engine, "supports_spec_pipelined", False)
         ),
+        # the recompile witness is armed (counting) from here on; strict
+        # means DLLAMA_JITCHECK=1 will raise on any post-warmup compile
+        jitcheck_strict=jitcheck.enabled(),
         seq_len=engine.config.seq_len,
     )

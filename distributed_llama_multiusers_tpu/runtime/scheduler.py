@@ -49,6 +49,7 @@ from ..serving import (
     drain_scheduler,
     queue_expired,
 )
+from ..analysis import jitcheck
 from ..serving.watchdog import deadline_from_env
 from ..telemetry import Telemetry
 from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerChatStops
@@ -498,6 +499,12 @@ class ContinuousBatchingScheduler:
                 and getattr(self.engine, "pipeline_depth", 0) == 2
             ),
             prefix_min_tokens=self.prefix_min_tokens,
+            # compile stability: True once warmup_engine armed the
+            # recompile witness (analysis/jitcheck.py) — the normal
+            # make_scheduler order warms before start(), so a False here
+            # means this scheduler is serving UNWARMED programs and
+            # every first dispatch will compile mid-request
+            jitcheck_armed=jitcheck.armed(),
             queue_capacity=getattr(self.queue, "capacity", None),
             queue_timeout_s=self.deadlines.queue_timeout_s,
             request_budget_s=self.deadlines.request_budget_s,

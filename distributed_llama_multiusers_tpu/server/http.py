@@ -391,6 +391,13 @@ class ApiServer:
             # non-zero only on pod processes that actually restarted
             "worker_restarts": stats["worker_restarts"],
             "worker_replay_errors": stats["worker_replay_errors"],
+            # compile stability (analysis/jitcheck.py): XLA compiles
+            # observed after warmup armed the recompile witness — MUST
+            # read 0 in steady serving (one compiled program per
+            # (family, bucket), compiled only at warmup); /metrics
+            # carries the dllama_stats_* gauge plus the delta-fed
+            # dllama_jit_compiles_total counter (telemetry/hub)
+            "jit_compiles_after_warmup": stats["jit_compiles_after_warmup"],
             "lanes_total": total,
             "lanes_busy": busy,
         }

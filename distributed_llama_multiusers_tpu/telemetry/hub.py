@@ -142,7 +142,21 @@ class Telemetry:
             "crashed requests re-admitted by journal replay (the /stats "
             "recovered_requests field, delta-fed)",
         )
+        # compile stability (analysis/jitcheck.py): post-warmup XLA
+        # compiles as a native counter next to the
+        # dllama_stats_jit_compiles_after_warmup gauge the bridge
+        # republishes — delta-fed with the sync-bytes recipe so alerting
+        # on `increase(dllama_jit_compiles_total[5m]) > 0` works even
+        # across /stats window semantics; MUST stay flat in steady
+        # serving (one compiled program per family/bucket, warmup-only)
+        self.jit_compiles = reg.counter(
+            "dllama_jit_compiles_total",
+            "XLA backend compiles observed after warmup_engine armed the "
+            "recompile witness (the /stats jit_compiles_after_warmup "
+            "field, delta-fed) — non-zero means a mid-serving recompile",
+        )
         self._sync_bytes_seen = 0
+        self._jit_compiles_seen = 0.0
         self._spec_emitted_seen = 0.0
         self._journal_records_seen = 0.0
         self._recovered_seen = 0.0
@@ -428,6 +442,11 @@ class Telemetry:
              "_journal_records_seen"),
             ("recovered_requests", self.recovered_requests,
              "_recovered_seen"),
+            # jit_compiles_after_warmup never resets within a process
+            # (engine.stats.reset() deliberately keeps it), so the
+            # monotone delta-feed recipe applies verbatim
+            ("jit_compiles_after_warmup", self.jit_compiles,
+             "_jit_compiles_seen"),
         ):
             v = stats.get(fld)
             if isinstance(v, (int, float)) and not isinstance(v, bool):

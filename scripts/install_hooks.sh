@@ -1,0 +1,47 @@
+#!/bin/sh
+# Install the repo's git pre-commit hook: the diff-proportional dlint
+# run (`dlint --changed HEAD`, shipped in PR 14 — docs/LINT.md "Linting
+# just the diff"). Findings surface at commit time instead of in
+# tier-1; `git commit --no-verify` stays the escape hatch.
+#
+# Idempotent: re-running refreshes a hook this script installed (the
+# marker line below identifies it) and REFUSES to clobber any other
+# pre-commit hook — chain dlint from your own hook instead.
+#
+# Usage: scripts/install_hooks.sh   (or `make hooks`)
+set -eu
+
+MARKER="# dlint-pre-commit-hook"
+
+repo_root=$(git rev-parse --show-toplevel 2>/dev/null) || {
+    echo "install_hooks.sh: not inside a git work tree" >&2
+    exit 1
+}
+# honor core.hooksPath when set (defaults to .git/hooks)
+hooks_dir=$(git -C "$repo_root" rev-parse --git-path hooks)
+case "$hooks_dir" in
+    /*) : ;;
+    *) hooks_dir="$repo_root/$hooks_dir" ;;
+esac
+hook="$hooks_dir/pre-commit"
+
+if [ -e "$hook" ] && ! grep -q "$MARKER" "$hook" 2>/dev/null; then
+    echo "install_hooks.sh: $hook exists and was not installed by this" >&2
+    echo "script — not clobbering it. Add this line to your hook instead:" >&2
+    echo "  python -m distributed_llama_multiusers_tpu.analysis --changed HEAD" >&2
+    exit 1
+fi
+
+mkdir -p "$hooks_dir"
+cat > "$hook" <<EOF
+#!/bin/sh
+$MARKER
+# Diff-proportional project-invariant lint (docs/LINT.md): only files
+# changed vs HEAD are checked, but every file still feeds the
+# cross-file models (locks, protocol surface, jit surface), so a
+# violation against an unchanged declaration is still found.
+# Bypass for a single commit with: git commit --no-verify
+exec python -m distributed_llama_multiusers_tpu.analysis --changed HEAD
+EOF
+chmod +x "$hook"
+echo "installed $hook (dlint --changed HEAD; bypass: git commit --no-verify)"
