@@ -49,6 +49,11 @@ SCOPE = (
     "serving/journal.py", "serving/recovery.py",
     # migration ships the same admit record between replicas
     "fleet/migrate.py",
+    # disagg hand-off rides the same replay closure: the decode replica
+    # regenerates the session from the ticket, and the page bundle's
+    # integrity hashes must be a pure function of (geometry, tokens,
+    # payload) — any entropy here would break cross-replica adoption
+    "disagg/kvtransfer.py", "disagg/prefill.py",
     # schema canonicalization: every process compiles the same automaton
     "grammar/automaton.py",
     # the admit-record build (resolved seed, QoS class, deadlines)

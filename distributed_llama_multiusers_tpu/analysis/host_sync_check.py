@@ -74,6 +74,11 @@ SCOPE = (
     # CONTRACT — a device transfer spelling here would serialize every
     # constrained dispatch on the automaton tables
     "grammar/__init__.py", "grammar/automaton.py", "grammar/slab.py",
+    # disaggregated prefill is pure stdlib BY DESIGN like fleet/: page
+    # payloads cross replicas as OPAQUE bytes behind the engine's
+    # export/import hooks — a transfer spelling here would mean device
+    # state leaked into the hand-off orchestration layer
+    "disagg/__init__.py", "disagg/kvtransfer.py", "disagg/prefill.py",
 )
 CAST_SCOPE = ("runtime/engine.py",)
 

@@ -232,6 +232,16 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "replica. Default: host:port (the machine "
                         "hostname when binding all interfaces — a fleet "
                         "of 0.0.0.0:8080s would all share one id)")
+    p.add_argument("--role", default="mixed",
+                   choices=["mixed", "prefill", "decode"],
+                   help="serving: this replica's fleet role, advertised "
+                        "on GET /load. 'prefill': the dllama-router "
+                        "steers long-classified prompts here and hands "
+                        "their sessions (KV pages + migration ticket) to "
+                        "a decode replica at first token "
+                        "(disagg/; docs/DISAGG.md). 'decode': preferred "
+                        "hand-off target. 'mixed' (default): the "
+                        "monolithic single-tier behavior")
     p.add_argument("--reconnect-grace", type=float, default=0.0,
                    help="serving: seconds a disconnected SSE client may "
                         "reattach (GET /v1/stream/<id> with "
@@ -304,6 +314,17 @@ def build_router_parser(prog: str = "dllama-router") -> argparse.ArgumentParser:
                         "need --reconnect-grace > 0 for the reattach "
                         "half. 'off': mid-stream failures surface to "
                         "the client as typed errors instead")
+    p.add_argument("--disagg-threshold", type=int, default=None,
+                   help="disaggregated prefill: prompts at/above this "
+                        "many characters classify 'long' and route to a "
+                        "replica advertising role=prefill on /load; at "
+                        "first token the session (KV-page bundle + "
+                        "migration ticket) hands off to a decode "
+                        "replica, char-exact on the same client socket. "
+                        "0 disables the policy. Default: disagg default "
+                        "(8000). Needs --migration on and at least one "
+                        "--role prefill replica to take effect; without "
+                        "them every request rides the monolithic path")
     return p
 
 

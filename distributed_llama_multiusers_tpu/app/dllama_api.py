@@ -48,9 +48,11 @@ def main(argv=None) -> None:
                      "stream reattach)"))
     server = ApiServer(scheduler, tokenizer, model_name=model_name,
                        template_type=template_type, resume=registry,
-                       replica_id=getattr(args, "replica_id", None))
+                       replica_id=getattr(args, "replica_id", None),
+                       role=getattr(args, "role", "mixed"))
     httpd = server.serve(host=args.host, port=args.port)
-    log("⭐", f"Server listening on {args.host}:{args.port} ({engine.n_lanes} lanes)")
+    log("⭐", f"Server listening on {args.host}:{args.port} "
+              f"({engine.n_lanes} lanes, role {server.role})")
 
     def _sigterm(*_):
         # rolling-restart signal: flip /health + shed NEW submissions

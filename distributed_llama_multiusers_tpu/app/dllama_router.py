@@ -16,6 +16,7 @@ from __future__ import annotations
 import signal
 import threading
 
+from ..disagg.prefill import DEFAULT_LONG_PROMPT_CHARS
 from ..fleet import FleetRouter
 from ..fleet.balancer import DEFAULT_AFFINITY_BLOCKS, DEFAULT_BLOCK_CHARS
 from .args import build_router_parser
@@ -36,12 +37,18 @@ def main(argv=None) -> None:
         DEFAULT_AFFINITY_BLOCKS if args.affinity_blocks is None
         else args.affinity_blocks
     )
+    threshold = (
+        DEFAULT_LONG_PROMPT_CHARS if args.disagg_threshold is None
+        else args.disagg_threshold
+    )
     router = FleetRouter(
         list(args.replicas),
         affinity_block_chars=max(1, block_chars),
         affinity_blocks=max(0, blocks),
         scrape_interval_s=args.scrape_interval,
         migration=args.migration == "on",
+        disagg=threshold > 0,
+        long_prompt_chars=threshold,
     ).start()
     router.scrape_once()  # first routing decision sees real load state
     httpd = router.serve(host=args.host, port=args.port)
@@ -50,7 +57,10 @@ def main(argv=None) -> None:
     log("🧭", "prefix affinity "
               + (f"on ({blocks} x {block_chars} chars)" if blocks > 0
                  else "off")
-              + f"; migration {args.migration}")
+              + f"; migration {args.migration}"
+              + f"; disagg "
+              + (f"on (long >= {threshold} chars -> prefill replicas)"
+                 if threshold > 0 else "off"))
 
     def _sigterm(*_):
         log("⭐", "SIGTERM: router stopping (in-flight streams finish)")

@@ -1,0 +1,60 @@
+"""Disaggregated prefill (DistServe/Splitwise pattern): prefill and
+decode as independently scalable fleet resources.
+
+A single 100k-token prompt used to ride the decode chain of whichever
+replica owned it, taxing every co-resident lane's TBT. This package
+lets the router designate prefill-role replicas that build KV pages and
+ship them to decode replicas:
+
+- :mod:`.kvtransfer` — bulk KV-page export/import on top of
+  ``runtime/kvpool.py``: integrity-hashed page bundles, refcount-correct
+  adoption into the destination pool's prefix tree. Serialized either
+  over HTTP between replicas (``server/http.py`` admin endpoints) or as
+  the ``OP_KV_PAGES`` pod wire op (``parallel/multihost.py``).
+- :mod:`.prefill` — the hand-off orchestration: prompt-length
+  classification, the prefill worker contract (prefill on the prefill
+  replica, first token proves the pages are committed), and the
+  page-transfer + ticket-migration sequence that moves the session to a
+  decode replica char-exact (PR 12's ``fleet/migrate.py`` machinery).
+
+Pure stdlib, like ``serving/`` and ``fleet/``: importable wherever
+dlint runs, no numpy/jax — the device half stays in ``runtime/engine``
+behind the ``export_kv_page``/``import_kv_page`` hooks.
+
+See docs/DISAGG.md for the wire format, the hand-off ticket lifecycle
+and the failure-mode table.
+"""
+
+from .kvtransfer import (
+    BUNDLE_VERSION,
+    KVTransferError,
+    adopt_bundle,
+    decode_bundle,
+    export_bundle,
+    page_hash,
+)
+from .prefill import (
+    DEFAULT_LONG_PROMPT_CHARS,
+    HandoffAborted,
+    classify_prompt,
+    fetch_pages,
+    hand_off,
+    prompt_chars,
+    push_pages,
+)
+
+__all__ = [
+    "BUNDLE_VERSION",
+    "KVTransferError",
+    "adopt_bundle",
+    "decode_bundle",
+    "export_bundle",
+    "page_hash",
+    "DEFAULT_LONG_PROMPT_CHARS",
+    "HandoffAborted",
+    "classify_prompt",
+    "fetch_pages",
+    "hand_off",
+    "prompt_chars",
+    "push_pages",
+]

@@ -94,12 +94,13 @@ class ReplicaState:
     __slots__ = (
         "rid", "base", "queue_depth", "lanes_free", "lanes_total",
         "breaker", "draining", "pool_pages_free", "pool_parked_pages",
-        "retry_until", "dead", "scrape_ok", "routed",
+        "retry_until", "dead", "scrape_ok", "routed", "role",
     )
 
     def __init__(self, base: str, rid: str | None = None):
         self.base = str(base)  # "host:port"
         self.rid = str(rid or base)
+        self.role = "mixed"  # "prefill" | "decode" | "mixed", from /load
         self.queue_depth = 0
         self.lanes_free = 0
         self.lanes_total = 0
@@ -207,21 +208,29 @@ class FleetBalancer:
     # -- picks ---------------------------------------------------------------
 
     def pick(self, key: int | None = None,
-             exclude: set[str] | frozenset = frozenset()) -> ReplicaState | None:
+             exclude: set[str] | frozenset = frozenset(),
+             role: str | None = None) -> ReplicaState | None:
         """Choose a replica: by affinity ring when ``key`` is given (walk
         past ineligible replicas — consistent-hash failover), else least
         loaded. ``exclude`` holds replicas already tried this request.
-        ``None`` when no replica is eligible (the router gives up with
-        the aggregate 503 + the smallest Retry-After hint)."""
+        ``role`` restricts the pick to replicas advertising that role on
+        their ``/load`` surface (disagg routing: long prompts ask for
+        ``"prefill"``; the caller falls back to a role-free pick when
+        no such replica is eligible — the monolithic path). ``None``
+        when no replica is eligible (the router gives up with the
+        aggregate 503 + the smallest Retry-After hint)."""
         now = time.monotonic()
+
+        def ok(s: ReplicaState) -> bool:
+            if role is not None and s.role != role:
+                return False
+            return self._eligible_locked(s, now, exclude)
+
         with self._lock:
             if key is not None:
                 self._fb_affinity_routes += 1
                 owner = self._ring_walk_locked(key, lambda s: True)
-                rid = self._ring_walk_locked(
-                    key,
-                    lambda s: self._eligible_locked(s, now, exclude),
-                )
+                rid = self._ring_walk_locked(key, ok)
                 if rid is None:
                     return None
                 if rid == owner:
@@ -229,8 +238,7 @@ class FleetBalancer:
                 state = self._fb_replicas[rid]
             else:
                 candidates = [
-                    s for s in self._fb_replicas.values()
-                    if self._eligible_locked(s, now, exclude)
+                    s for s in self._fb_replicas.values() if ok(s)
                 ]
                 if not candidates:
                     return None
@@ -282,6 +290,7 @@ class FleetBalancer:
             state.draining = bool(load.get("draining", False))
             state.pool_pages_free = load.get("pool_pages_free")
             state.pool_parked_pages = load.get("pool_parked_pages")
+            state.role = str(load.get("role", "mixed") or "mixed")
             state.dead = False
             state.scrape_ok = True
 
@@ -352,6 +361,7 @@ class FleetBalancer:
                 "fleet_replica_table": {
                     s.rid: {
                         "base": s.base,
+                        "role": s.role,
                         "queue_depth": s.queue_depth,
                         "lanes_free": s.lanes_free,
                         "lanes_total": s.lanes_total,

@@ -15,6 +15,17 @@ the serving stack already exposes —
   eligible replica. Only when EVERY replica is shedding or unreachable
   does the client see a failure: one aggregate 503 whose Retry-After is
   the smallest outstanding hint in the fleet.
+- **disaggregated prefill** (disagg/): requests classified **long** by
+  prompt length (``--disagg-threshold`` chars) route to a replica
+  advertising ``role: prefill`` on its ``/load``; once the first delta
+  proves the prompt's KV pages are committed there, the router moves
+  the session to a decode replica — KV-page bundle first
+  (``/admin/kvpages`` → ``/admin/kvimport``, integrity-hashed), then
+  the migration ticket, then reattach — so long prompts stop taxing
+  co-resident decode TBT. Any hand-off failure (including the prefill
+  replica dying mid-transfer) degrades to the monolithic path: the
+  router keeps pumping whatever stream it has, typed fallback counters
+  record why.
 - **live migration** (fleet/migrate.py): the router caches each
   stream's migration ticket (the session's exported journal admit
   record) at stream start; when the serving replica dies mid-stream, is
@@ -46,6 +57,12 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..disagg.prefill import (
+    DEFAULT_LONG_PROMPT_CHARS,
+    HandoffAborted,
+    classify_prompt,
+    hand_off,
+)
 from ..telemetry.metrics import MetricsRegistry, log_buckets
 from .balancer import (
     DEFAULT_AFFINITY_BLOCKS,
@@ -86,7 +103,7 @@ class _StreamSession:
 
     __slots__ = ("key", "request_id", "ticket", "deltas_out",
                  "chars_out", "terminal_seen", "pending_error",
-                 "migrations")
+                 "migrations", "handoff_due")
 
     def __init__(self, key):
         self.key = key  # affinity key (None = keyless)
@@ -97,6 +114,10 @@ class _StreamSession:
         self.terminal_seen = False
         self.pending_error = None
         self.migrations = 0
+        # disagg: True while a prefill→decode hand-off is owed — armed
+        # when the stream lands on a prefill-role replica, cleared at
+        # the (single) attempt so a fallback never retries forever
+        self.handoff_due = False
 
 
 class FleetRouter:
@@ -110,7 +131,9 @@ class FleetRouter:
                  scrape_interval_s: float = DEFAULT_SCRAPE_INTERVAL_S,
                  migration: bool = True,
                  connect_timeout_s: float = DEFAULT_CONNECT_TIMEOUT_S,
-                 read_timeout_s: float = DEFAULT_READ_TIMEOUT_S):
+                 read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                 disagg: bool = True,
+                 long_prompt_chars: int = DEFAULT_LONG_PROMPT_CHARS):
         self.balancer = balancer or FleetBalancer(replicas)
         self.affinity_block_chars = int(affinity_block_chars)
         self.affinity_blocks = int(affinity_blocks)
@@ -118,6 +141,12 @@ class FleetRouter:
         self.migration = bool(migration)
         self.connect_timeout_s = float(connect_timeout_s)
         self.read_timeout_s = float(read_timeout_s)
+        # disaggregated prefill: classify by prompt length and steer
+        # long prompts to prefill-role replicas; <=0 threshold (or
+        # disagg=False) turns the whole policy off — everything
+        # classifies "short" and no hand-offs are armed
+        self.disagg = bool(disagg)
+        self.long_prompt_chars = int(long_prompt_chars)
         # plain counters for /stats (single GIL-atomic int bumps, the
         # scheduler-counter pattern); the registry carries the same
         # signals as native Prometheus series for /metrics
@@ -127,6 +156,10 @@ class FleetRouter:
         self.migrations_ok = 0
         self.migrations_failed = 0
         self.redispatches = 0
+        self.disagg_handoffs_ok = 0
+        self.disagg_fallbacks = 0
+        self.disagg_pages_moved = 0  # pages adopted by decode replicas
+        self.disagg_pages_fresh = 0  # ...whose payload actually shipped
         self.registry = MetricsRegistry()
         self._m_routed = self.registry.counter(
             "dllama_router_requests_total",
@@ -151,6 +184,20 @@ class FleetRouter:
         self._m_migration_s = self.registry.histogram(
             "dllama_router_migration_seconds",
             "stream break detected -> first resumed byte forwarded",
+            buckets=MIGRATION_BUCKETS_S,
+        )
+        self._m_disagg = self.registry.counter(
+            "dllama_router_disagg_handoffs_total",
+            "prefill->decode hand-offs, by outcome "
+            "(fallbacks carry the typed abort reason)",
+        )
+        self._m_disagg_pages = self.registry.counter(
+            "dllama_router_disagg_pages_total",
+            "KV pages adopted across replicas, by kind (fresh/reused)",
+        )
+        self._m_handoff_s = self.registry.histogram(
+            "dllama_router_disagg_handoff_seconds",
+            "first prefill delta -> decode stream reattached",
             buckets=MIGRATION_BUCKETS_S,
         )
         self._stop_evt = threading.Event()
@@ -246,6 +293,13 @@ class FleetRouter:
             "router_migrations_ok": self.migrations_ok,
             "router_migrations_failed": self.migrations_failed,
             "router_redispatches": self.redispatches,
+            "router_disagg_handoffs_ok": self.disagg_handoffs_ok,
+            "router_disagg_fallbacks": self.disagg_fallbacks,
+            "router_disagg_pages_moved": self.disagg_pages_moved,
+            "router_disagg_pages_fresh": self.disagg_pages_fresh,
+            "router_long_prompt_chars": (
+                self.long_prompt_chars if self.disagg else 0
+            ),
         }
         out.update(self.balancer.stats())
         return out
@@ -320,12 +374,27 @@ class FleetRouter:
         already written)."""
         streaming = sse is not None
         key = self.affinity_key(body)
+        # prompt-length class: "long" routes to a prefill-role replica
+        # (disagg); short traffic keeps today's affinity/least-loaded
+        len_class = (
+            classify_prompt(body, self.long_prompt_chars)
+            if self.disagg else "short"
+        )
         body_bytes = json.dumps(body).encode()
         tried: set[str] = set()
         sheds: dict[str, dict] = {}
         attempts = 0
         while True:
-            state = self.balancer.pick(key, exclude=tried)
+            state = None
+            if len_class == "long":
+                # least-loaded among prefill-role replicas (keyless on
+                # purpose: a long prompt's pages will MOVE, so pinning
+                # it to the affinity ring owner buys nothing); when no
+                # prefill replica is eligible the normal pick below is
+                # the monolithic fallback
+                state = self.balancer.pick(exclude=tried, role="prefill")
+            if state is None:
+                state = self.balancer.pick(key, exclude=tried)
             if state is None:
                 break
             tried.add(state.rid)
@@ -351,12 +420,24 @@ class FleetRouter:
                 continue
             # routed (served or a non-shed error the client should see)
             self.routed_total += 1
+            # the per-request routing decision, attributable in one
+            # scrape: which replica, which placement mode, the prompt's
+            # length class and the serving replica's advertised role
             self._m_routed.inc(
                 replica=state.rid,
                 mode="affinity" if key is not None else "load",
+                len_class=len_class,
+                role=state.role,
             )
             if verdict == "ok":
-                self._pump_stream(sse, a, b, state, key, path, body_bytes)
+                self._pump_stream(
+                    sse, a, b, state, key, path, body_bytes,
+                    handoff=(
+                        self.disagg and self.migration
+                        and len_class == "long"
+                        and state.role == "prefill"
+                    ),
+                )
                 return None
             status, data, (ctype, served_by) = a, b, c
             # the replica's attribution header passes through, so fleet
@@ -384,12 +465,18 @@ class FleetRouter:
     # -- streaming pump + migration ------------------------------------------
 
     def _pump_stream(self, sse, conn, resp, state, key, path,
-                     body_bytes) -> None:
+                     body_bytes, handoff: bool = False) -> None:
         """Own a streaming request end-to-end: commit the client SSE
         headers, pump the upstream body through, and on a mid-stream
         failure migrate to another replica and keep pumping — same
-        client socket, zero lost/duplicated output."""
+        client socket, zero lost/duplicated output. With ``handoff``
+        (a long prompt landed on a prefill-role replica) the pump
+        pauses after the FIRST forwarded delta — the proof that
+        prefill committed its pages — and tries the disagg hand-off;
+        a failed hand-off simply resumes the same upstream stream (the
+        monolithic fallback, the source never stopped decoding)."""
         st = _StreamSession(key)
+        st.handoff_due = handoff
         tried = {state.rid}
         sse.headers(state.rid)
         skip_chars = 0
@@ -403,6 +490,25 @@ class FleetRouter:
                 # own disconnect semantics (cancel / grace) apply
                 conn.close()
                 return
+            if outcome == "handoff":
+                nxt = self._hand_off(st, state)
+                if nxt is None:
+                    # typed fallback (counted in _hand_off): the source
+                    # stream is still live and still ours — keep
+                    # pumping it. skip_chars resets: the SAME response
+                    # body continues, nothing replays.
+                    skip_chars = 0
+                    continue
+                # the decode replica replays from 0; close the source
+                # only now, after the reattach succeeded (closing it
+                # earlier would burn the fallback path)
+                conn.close()
+                conn, resp, state = nxt
+                tried.add(state.rid)
+                skip_chars = st.chars_out  # char-exact dedup floor
+                st.pending_error = None
+                st.terminal_seen = False
+                continue
             conn.close()
             tried.add(state.rid)
             if outcome == "done":
@@ -540,6 +646,12 @@ class FleetRouter:
                     st.deltas_out += 1
                     st.chars_out += len(text)
                     sse.chunk(payload, event_id=st.deltas_out)
+                    if st.handoff_due:
+                        # disagg: the first delta PROVES the prompt's
+                        # blocks are committed to the prefill replica's
+                        # pool — pause here and try the hand-off (the
+                        # caller resumes this same stream on fallback)
+                        return "handoff"
                     continue
                 if fin in ("cancelled", "error"):
                     # the source gave the request up mid-flight (drain
@@ -567,6 +679,57 @@ class FleetRouter:
             )
         except _TRANSPORT_ERRORS:
             st.ticket = None
+
+    def _hand_off(self, st: _StreamSession, src: ReplicaState):
+        """Disagg prefill→decode hand-off (disagg/prefill.py): page
+        bundle, then ticket, then reattach. Returns ``(conn, resp,
+        state)`` on the decode replica or ``None`` — and ``None`` is
+        ALWAYS safe: the session is still streaming on ``src``, the
+        caller just keeps pumping it (typed fallback, never a hung
+        stream). One attempt per stream: ``handoff_due`` clears here."""
+        st.handoff_due = False
+
+        def fallback(reason: str):
+            self.disagg_fallbacks += 1
+            self._m_disagg.inc(outcome="fallback", reason=reason)
+            return None
+
+        if st.request_id is None:
+            return fallback("no_request_id")
+        tried = {src.rid}
+        # decode-role replicas first; a mixed fleet (no explicit decode
+        # role) falls back to any eligible non-source replica
+        state = self.balancer.pick(exclude=tried, role="decode")
+        if state is None:
+            state = self.balancer.pick(st.key, exclude=tried)
+        if state is None:
+            return fallback("no_decode_replica")
+        src_host, src_port = src.host_port()
+        dst_host, dst_port = state.host_port()
+        t0 = time.perf_counter()
+        try:
+            conn, resp, new_rid, receipt = hand_off(
+                src_host, src_port, st.request_id, dst_host, dst_port,
+                timeout=self.connect_timeout_s,
+                read_timeout=self.read_timeout_s,
+            )
+        except HandoffAborted as e:
+            # covers the prefill replica dying mid-transfer (ticket or
+            # page fetch fails → no_ticket / transport reasons): the
+            # caller's next pump pass hits the broken source and takes
+            # the NORMAL migration path off the cached ticket
+            return fallback(e.reason)
+        st.request_id = new_rid
+        self.disagg_handoffs_ok += 1
+        self.disagg_pages_moved += int(receipt.get("pages", 0) or 0)
+        self.disagg_pages_fresh += int(receipt.get("fresh", 0) or 0)
+        self._m_disagg.inc(outcome="ok")
+        self._m_disagg_pages.inc(
+            float(receipt.get("fresh", 0) or 0), kind="fresh")
+        self._m_disagg_pages.inc(
+            float(receipt.get("reused", 0) or 0), kind="reused")
+        self._m_handoff_s.observe(time.perf_counter() - t0)
+        return conn, resp, state
 
     def _migrate(self, st: _StreamSession, failed: ReplicaState):
         """Move a broken stream: inject the cached ticket into the next

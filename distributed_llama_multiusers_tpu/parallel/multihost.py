@@ -80,7 +80,15 @@ PACKET_MAGIC = 0x444C4C41  # "DLLA"
 # locally by every process against its own tokenizer table). The packet
 # size changed, so a v3 peer cannot frame a v4 broadcast — the version
 # word classifies it.
-PROTOCOL_VERSION = 4
+# v5: disaggregated prefill — OP_KV_PAGES ships whole KV-page payloads
+# (a prefill replica's committed pages adopted into this pod's pool,
+# disagg/kvtransfer.py). The packet size did NOT change, so a v4 peer
+# COULD frame a v5 broadcast and would replay every op except the page
+# imports — adopted pages would read as garbage KV on that process's
+# shard (wrong gathers, not a deadlock), the same silent-divergence
+# class v3 closed for table rows. The bump classifies it on the first
+# packet.
+PROTOCOL_VERSION = 5
 
 OP_STOP = 0
 OP_PREFILL = 1
@@ -125,6 +133,17 @@ OP_GRAMMAR = 13  # grammar-constrained decoding (grammar/): broadcast a
 # accumulate fragments until the final one, then attach/detach. The
 # root compiles and validates BEFORE the first packet (the pod-deadlock
 # rule: a schema that cannot compile dies with zero packets out).
+OP_KV_PAGES = 14  # disaggregated prefill (disagg/kvtransfer.py): import a
+# transferred KV page's raw payload bytes into the pool arrays on every
+# process. Framed like OP_GRAMMAR: `lane` carries flags (bit 0: final
+# fragment of this page's payload), `n` the fragment byte length,
+# `start_pos` the DESTINATION page id; payload bytes ride slot 0 as
+# packed int32 words. Workers accumulate fragments (the op stream is
+# ordered and one page's fragments are contiguous) and on the final one
+# dispatch engine.import_kv_page — the same warmed single-page write
+# program the root runs, so the replicated pool arrays stay
+# byte-identical. Pool bookkeeping (adopt(), refcounts, prefix tree)
+# stays root-only HOST state, exactly like OP_KV_TABLE's split.
 
 
 class ReplayError(RuntimeError):
@@ -468,6 +487,35 @@ class ControlPlane:
                 "ControlPlane(chunk=...) >= the engine's blocks-per-lane"
             )
         self._send(OP_KV_TABLE, lane, len(row), len(copies), row, flat)
+
+    def send_kv_pages(self, pages) -> None:
+        """Broadcast transferred KV page payloads (disagg adoption):
+        each ``(page, payload_bytes)`` is chunked into packet-slot
+        fragments like ``send_grammar``'s schema bytes — flags in
+        ``lane`` (bit 0: final fragment of this page), fragment byte
+        length in ``n``, the destination page id in ``start_pos``.
+        Raises pre-broadcast (the pod-deadlock rule) on a negative page
+        id — payload-size validation against the pool geometry is the
+        caller's job (RootControlEngine.import_kv_page), since the
+        plane does not know the engine's page shape."""
+        frag_bytes = self.chunk * 4  # int32 words carry 4 payload bytes
+        for page, payload in pages:
+            if int(page) < 0:
+                raise ValueError(
+                    f"kv page id must be >= 0, got {page}"
+                )
+            blob = bytes(payload)
+            frags = [
+                blob[off : off + frag_bytes]
+                for off in range(0, max(1, len(blob)), frag_bytes)
+            ]
+            for idx, frag in enumerate(frags):
+                flags = 1 if idx == len(frags) - 1 else 0
+                pad = (-len(frag)) % 4
+                words = np.frombuffer(frag + b"\0" * pad, np.uint8).view(
+                    np.int32
+                )
+                self._send(OP_KV_PAGES, flags, len(frag), int(page), words)
 
     def recv(self) -> np.ndarray:
         faults.fire("plane.recv")  # chaos harness; no-op unarmed
@@ -934,6 +982,25 @@ class RootControlEngine:
         self._plane.send_kv_table(-1, [], [])
         self._engine.paged_unmap_all()
 
+    def import_kv_page(self, page: int, payload: bytes) -> None:
+        """Disagg page import on a pod: validate ROOT-side first — a
+        non-paged engine or a geometry-skewed payload must die with zero
+        packets out (the pod-deadlock rule) — then broadcast the bytes
+        (OP_KV_PAGES) so every process dispatches the same page-write
+        program and the sharded pool arrays stay byte-identical.
+        warmup_engine drives this to pre-compile the write program."""
+        if getattr(self._engine, "kvpool", None) is None:
+            raise RuntimeError("import_kv_page needs a paged engine")
+        shape, dtype = self._engine._page_leaf_geometry()
+        half = int(np.prod(shape)) * dtype.itemsize
+        if len(payload) != 2 * half:
+            raise ValueError(
+                f"kv page payload is {len(payload)} bytes, expected "
+                f"{2 * half} for page geometry {tuple(shape)} {dtype}"
+            )
+        self._plane.send_kv_pages([(page, payload)])
+        self._engine.import_kv_page(page, payload)
+
 
 def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
     """Replay root-broadcast engine calls until OP_STOP — the SPMD twin of
@@ -944,6 +1011,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
     ``on_replay`` (if given) is called after each successfully replayed
     packet — ``worker_serve`` uses it to refresh its restart budget."""
     gram_buf = bytearray()  # OP_GRAMMAR fragment accumulator
+    page_buf = bytearray()  # OP_KV_PAGES fragment accumulator
     while True:
         pkt = plane.recv()
         # header: [magic, version, op, lane, n, start_pos] — magic/version
@@ -1149,6 +1217,33 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                         (int(d) for d in pairs[1::2]),
                     )),
                 )
+        elif op == OP_KV_PAGES:
+            # disagg page import: payload-byte fragments accumulate (the
+            # op stream is ordered and one page's fragments are
+            # contiguous); the destination page id rides start_pos. A
+            # non-paged engine receiving this is a config skew — root
+            # and worker disagree on --paged-kv — classified
+            # pre-dispatch, no collective was entered on it
+            if getattr(engine, "kvpool", None) is None:
+                raise ReplayError(
+                    "OP_KV_PAGES on a non-paged engine: root and worker "
+                    "--paged-kv flags are skewed"
+                )
+            frag = plane.slot(pkt, 0, (n + 3) // 4).view(np.uint8)[:n]
+            page_buf += frag.tobytes()
+            if lane & 1:  # final fragment of this page's payload
+                blob = bytes(page_buf)
+                page_buf = bytearray()
+                try:
+                    engine.import_kv_page(start_pos, blob)
+                except ValueError as e:
+                    # geometry skew (root and worker disagree on the
+                    # page shape/dtype): classified like OP_KV_TABLE's
+                    # row-width skew instead of burning a restart
+                    raise ReplayError(
+                        f"OP_KV_PAGES payload rejected: {e} — root and "
+                        "worker paged-KV geometry flags are skewed"
+                    ) from e
         else:
             # classified, pre-dispatch (no engine call was made for this
             # packet): worker_serve resubscribes without burning a restart

@@ -1032,6 +1032,20 @@ class InferenceEngine:
 
         self._copy_page_fn = _copy_page
 
+        @partial(jax.jit, donate_argnums=(0,))
+        def _write_page(cache, page, k_page, v_page):
+            # whole-page K/V write — the disagg IMPORT unit (a page
+            # arriving from a peer replica lands here). Traced page
+            # scalar + fixed host-array operand avals: ONE compile for
+            # any destination page, warmed at warmup like the COW copy.
+            return PagedKVCache(
+                k=cache.k.at[:, page].set(k_page),
+                v=cache.v.at[:, page].set(v_page),
+                table=cache.table,
+            )
+
+        self._write_page_fn = _write_page
+
         def _make_decode_multi(h):
             @partial(jax.jit, donate_argnums=(1,))
             def _decode_multi(params, cache, tokens, positions, temps, topps,
@@ -2165,6 +2179,48 @@ class InferenceEngine:
         ``{}`` on contiguous engines."""
         return self.kvpool.stats() if self.kvpool is not None else {}
 
+    def _page_leaf_geometry(self) -> tuple[tuple, "np.dtype"]:
+        """One page's K (or V) leaf shape/dtype: ``[L, page_size,
+        n_kv_heads, head_size]`` sliced out of the pool axis."""
+        k = self.cache.k
+        return (k.shape[0],) + tuple(k.shape[2:]), np.dtype(k.dtype)
+
+    def export_kv_page(self, page: int) -> bytes:
+        """Serialize physical page ``page``'s K/V bytes (K then V, raw
+        row-major) for cross-replica transfer (disagg/kvtransfer.py).
+        A host sync by design — the disagg hand-off IS a host round
+        trip, and it only runs on committed (immutable) pages, so the
+        bytes are stable while the source lane keeps decoding."""
+        if self.kvpool is None:
+            raise RuntimeError("export_kv_page needs a paged engine")
+        # dlint: ok[host-sync] sanctioned disagg export choke point: one committed page's K/V leaves the device here
+        k = np.asarray(self.cache.k[:, page])
+        # dlint: ok[host-sync] second half of the same sanctioned page export
+        v = np.asarray(self.cache.v[:, page])
+        return k.tobytes() + v.tobytes()
+
+    def import_kv_page(self, page: int, payload: bytes) -> None:
+        """Write a transferred page's K/V bytes into physical page
+        ``page`` (the inverse of :meth:`export_kv_page`), through the
+        warmed single-page write program — the donated cache pytree
+        orders it before any later-dispatched prefill/decode, exactly
+        like a COW copy. Raises ``ValueError`` on a size mismatch
+        (geometry-skewed peer) before touching the device."""
+        if self.kvpool is None:
+            raise RuntimeError("import_kv_page needs a paged engine")
+        shape, dtype = self._page_leaf_geometry()
+        half = int(np.prod(shape)) * dtype.itemsize
+        if len(payload) != 2 * half:
+            raise ValueError(
+                f"kv page payload is {len(payload)} bytes, expected "
+                f"{2 * half} for page geometry {tuple(shape)} {dtype}"
+            )
+        k_page = np.frombuffer(payload[:half], dtype=dtype).reshape(shape)
+        v_page = np.frombuffer(payload[half:], dtype=dtype).reshape(shape)
+        self.cache = self._write_page_fn(
+            self.cache, jnp.int32(page), k_page, v_page
+        )
+
     def reset_lane(self, lane: int) -> None:
         """Nothing to clear on device: a fresh request's prefill rewrites the
         lane's cache from position 0, and reads are masked to s <= pos."""
@@ -2294,6 +2350,15 @@ def warmup_engine(
                 np.full(pool.blocks_per_lane, pool.n_pages, np.int32),
                 [(0, 0)],
             )
+            exp = getattr(engine, "export_kv_page", None)
+            imp = getattr(engine, "import_kv_page", None)
+            if callable(exp) and callable(imp):
+                # the disagg page-write program: the first adopted page
+                # must not eat an XLA compile mid-service. Page 0's own
+                # zeros ride back over themselves through the real
+                # program (pod roots broadcast via the RootControlEngine
+                # override so workers compile too).
+                imp(0, exp(0))
         if pool is None and n > 1:
             # the contiguous prefix-reuse primitive (found by dlint's
             # warmup-coverage at adoption): the first shared-prefix

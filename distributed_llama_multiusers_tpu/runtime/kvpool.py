@@ -121,6 +121,7 @@ class KVPagePool:
             "admits", "prefix_admits", "prefix_tokens_shared",
             "cow_copies", "parked_evicted", "exhausted_sheds",
             "parked_total", "pool_resets",
+            "adopts", "adopted_pages_fresh",
         ),
     }
 
@@ -192,6 +193,8 @@ class KVPagePool:
         self.exhausted_sheds = 0
         self.parked_total = 0
         self.pool_resets = 0
+        self.adopts = 0  # disagg: chains adopted from a peer replica
+        self.adopted_pages_fresh = 0  # pages that needed a payload import
 
     @classmethod
     def for_seq_len(
@@ -412,6 +415,130 @@ class KVPagePool:
             self._lane_reg[lane] = reg
             self._lane_tip[lane] = key
 
+    # -- disaggregated prefill: chain export / adoption ----------------------
+
+    def chain_pages(self, tokens: list[int]) -> list[tuple[tuple, int]]:
+        """The longest registered prefix chain over ``tokens``'s FULL
+        blocks, as ``(block_tokens, physical_page)`` pairs in chain
+        order — the export surface for KV-page transfer (disagg/
+        kvtransfer.py). Only committed tree nodes are visible: a lane's
+        partial tail block and unshared reservation never leave the
+        replica, which is exactly the immutability rule that makes the
+        exported bytes stable while the source lane keeps decoding."""
+        with self._lock:
+            bs = self.page_size
+            out: list[tuple[tuple, int]] = []
+            key = _ROOT
+            for i in range(len(tokens) // bs):
+                blk = tuple(tokens[i * bs: (i + 1) * bs])
+                page = self._nodes.get((key, blk))
+                if page is None:
+                    break
+                key = (key, blk)
+                out.append((blk, page))
+            return out
+
+    def adopt(self, token_blocks: list) -> tuple[list[int], list[tuple[int, int]]]:
+        """Adopt a transferred block chain into THIS pool's prefix tree.
+        ``token_blocks`` is the chain's full blocks (page_size tokens
+        each) in order. Returns ``(pages, fresh)``:
+
+        - ``pages`` — the chain's physical pages here, in block order;
+        - ``fresh`` — ``(block_index, page)`` pairs for blocks that had
+          no local node and were newly allocated: ONLY these need their
+          KV payload imported (engine ``import_kv_page``). Blocks the
+          local tree already held are reused by refcount — adopting a
+          chain a replica partly knows moves only the missing suffix.
+
+        The whole chain is pinned by a park entry (the same LRU slot a
+        ``finish(park=True)`` would create, identical-chain dedup
+        included), so the adopted prefix survives until a real admission
+        shares it or LRU pressure evicts it — refcount-correct by
+        construction: each chain page carries exactly one park-held ref,
+        like any parked session. Raises :class:`PoolExhausted` WITHOUT
+        mutating when free + evictable-parked pages cannot cover the
+        missing suffix, and ``ValueError`` for malformed blocks or a
+        parking-disabled pool (nothing would pin the adopted pages)."""
+        with self._lock:
+            bs = self.page_size
+            if self.max_parked <= 0:
+                raise ValueError(
+                    "adopt needs parking enabled (max_parked > 0): a "
+                    "parkless pool would free the adopted pages at once"
+                )
+            chain = [tuple(blk) for blk in token_blocks]
+            if not chain:
+                raise ValueError("adopt: empty block chain")
+            if any(len(blk) != bs for blk in chain):
+                raise ValueError(
+                    f"adopt: every block must hold exactly {bs} tokens "
+                    "(full committed blocks only cross replicas)"
+                )
+            # walk the chain over the local tree: reused prefix first
+            key = _ROOT
+            pages: list[int] = []
+            for blk in chain:
+                page = self._nodes.get((key, blk))
+                if page is None:
+                    break
+                key = (key, blk)
+                pages.append(page)
+            need = len(chain) - len(pages)
+            # sufficiency BEFORE any mutation (the admit() rule): a shed
+            # must leave the pool exactly as it found it
+            if len(self._free) < need:
+                evictable = sum(
+                    1 for p, held in self._park_refs.items()
+                    if self._ref[p] == held
+                )
+                if len(self._free) + evictable < need:
+                    self.exhausted_sheds += 1
+                    raise PoolExhausted(need, len(self._free), self.n_pages)
+            # pin reused pages BEFORE eviction — parked holders may be
+            # the only refs on the very prefix this adoption extends
+            for p in pages:
+                self._ref[p] += 1
+            if len(self._free) < need:
+                self._evict_parked_locked(need - len(self._free))
+            if len(self._free) < need:  # backstop: undo and shed
+                for p in pages:
+                    self._deref_locked(p)
+                self.exhausted_sheds += 1
+                raise PoolExhausted(need, len(self._free), self.n_pages)
+            fresh: list[tuple[int, int]] = []
+            for j in range(len(pages), len(chain)):
+                p = self._free.pop()
+                self._ref[p] = 1
+                blk = chain[j]
+                child = (key, blk)
+                self._nodes[child] = p
+                self._page_key[p] = child
+                self._children.setdefault(key, {})[blk] = p
+                key = child
+                pages.append(p)
+                fresh.append((j, p))
+            # park the whole chain: the operation's refs transfer to the
+            # park holder (finish(park=True)'s accounting, dedup included)
+            existing = self._park_index.get(tuple(pages))
+            if existing is not None:
+                self._parked.move_to_end(existing)
+                for p in pages:
+                    self._deref_locked(p)
+            else:
+                self._park_seq += 1
+                self._parked[self._park_seq] = list(pages)
+                self._park_index[tuple(pages)] = self._park_seq
+                for p in pages:
+                    if self._park_refs.get(p, 0) == 0:
+                        self._parked_pages += 1
+                    self._park_refs[p] = self._park_refs.get(p, 0) + 1
+                while len(self._parked) > self.max_parked:
+                    self._evict_oldest_locked()
+            self.parked_total += 1
+            self.adopts += 1
+            self.adopted_pages_fresh += len(fresh)
+            return list(pages), fresh
+
     # -- release / parking ---------------------------------------------------
 
     def finish(self, lane: int, park: bool = True) -> bool:
@@ -530,6 +657,14 @@ class KVPagePool:
         with self._lock:
             return list(self._lane_blocks[lane])
 
+    def page_key(self, page: int) -> tuple | None:
+        """The prefix-tree node key page ``page`` backs (``None`` for
+        pages holding no committed block) — a pure function of the block
+        CONTENT chain, which is what lets MockAsyncEngine derive a
+        content-canonical page payload for the disagg integrity hashes."""
+        with self._lock:
+            return self._page_key.get(int(page))
+
     def pages_free(self) -> int:
         with self._lock:
             return len(self._free)
@@ -557,6 +692,8 @@ class KVPagePool:
                 "pool_exhausted_sheds": self.exhausted_sheds,
                 "pool_parked_total": self.parked_total,
                 "pool_resets": self.pool_resets,
+                "pool_adopts": self.adopts,
+                "pool_adopted_pages_fresh": self.adopted_pages_fresh,
             }
 
     # -- internals (callers hold _lock) --------------------------------------

@@ -50,6 +50,7 @@ from ..serving import (
     queue_expired,
 )
 from ..analysis import jitcheck
+from ..lockcheck import make_lock
 from ..serving.watchdog import deadline_from_env
 from ..telemetry import Telemetry
 from ..tokenizer import EosDetector, EosResult, Sampler, Tokenizer, TokenizerChatStops
@@ -281,6 +282,13 @@ HOST_EXACT_TEMP = 1.5
 
 
 class ContinuousBatchingScheduler:
+    # dlint guarded-by declaration (analysis/lock_check.py): the pending
+    # device-op list moves only under its lock — appended by admin/HTTP
+    # threads (run_device_op), drained by the batching loop.
+    _dlint_guarded_by = {
+        ("_device_ops_lock",): ("_device_ops",),
+    }
+
     def __init__(
         self,
         engine,
@@ -422,6 +430,14 @@ class ContinuousBatchingScheduler:
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._thread: threading.Thread | None = None
+        # device ops posted by admin threads (disagg page export/import),
+        # executed by the batching loop at its next step boundary — the
+        # one point where engine.cache is the live chain output and the
+        # next dispatch has not yet donated it (run_device_op)
+        self._device_ops: list = []
+        self._device_ops_lock = make_lock(
+            "ContinuousBatchingScheduler._device_ops_lock"
+        )
         # failure containment (serving/breaker.py, serving/watchdog.py):
         # the supervised loop's admission gate + stall detector
         self.breaker = breaker or CircuitBreaker()
@@ -637,6 +653,84 @@ class ContinuousBatchingScheduler:
         out = dict(rec)
         out["watermark"] = len(req.generated_tokens)
         return out
+
+    def run_device_op(self, fn: Callable, timeout_s: float = 10.0):
+        """Run ``fn()`` on the batching-loop thread at its next step
+        boundary and return its result (exceptions re-raise here, with
+        their original type). Device-touching admin work — the disagg
+        page export/import (``export_kv_page`` / ``import_kv_page``) —
+        must NOT run on the calling HTTP thread: the pipelined chain
+        donates the cache pytree into every dispatch, so an admin-thread
+        read of ``engine.cache`` mid-chain hits a deleted buffer, and a
+        write would fork the pytree against the next dispatch. At the
+        loop's step boundary the consume half has rebound the live
+        arrays and nothing is in flight against them.
+
+        Runs ``fn`` inline when the loop is not running (tests, a
+        drained server — nothing to race) or when already ON the loop
+        thread. Raises ``TimeoutError`` if the loop never reaches a
+        boundary within ``timeout_s`` (wedged step; callers surface it
+        as a typed admin error, the router falls back monolithic)."""
+        thread = self._thread
+        if (
+            thread is None
+            or not thread.is_alive()
+            or threading.current_thread() is thread
+        ):
+            return fn()
+        box: dict = {}
+        done = threading.Event()
+        with self._device_ops_lock:
+            self._device_ops.append((fn, box, done))
+        if not done.wait(timeout_s):
+            raise TimeoutError(
+                "device op timed out waiting for a scheduler step boundary"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box.get("value")
+
+    def _drain_device_ops(self) -> None:
+        """Loop-thread half of :meth:`run_device_op`: execute pending
+        device ops at the step boundary. Op exceptions land in the
+        caller's box (re-raised on ITS thread) — never in the serving
+        loop, so a bad bundle cannot trip engine containment."""
+        while True:
+            with self._device_ops_lock:
+                if not self._device_ops:
+                    return
+                fn, box, done = self._device_ops.pop(0)
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — relayed to caller
+                box["error"] = e
+            finally:
+                done.set()
+
+    def export_session_pages(self, request_id: int) -> dict | None:
+        """Export a live session's committed KV-page bundle (disagg/
+        kvtransfer.py, ``GET /admin/kvpages/<id>``): the prompt's
+        registered prefix chain out of the paged pool, each page's
+        payload integrity-hashed. ``None`` for unknown/finished requests
+        and on contiguous engines (there are no pages to ship — the
+        hand-off degrades to ticket-only migration, which re-prefills).
+        Only FULL committed blocks export (immutable by the pool's
+        granularity rule), so the bytes are stable while this replica
+        keeps decoding the session."""
+        if getattr(self.engine, "kvpool", None) is None:
+            return None
+        got = self._session_records.get(int(request_id))
+        if got is None:
+            return None
+        from ..disagg.kvtransfer import export_bundle
+
+        rec, _req = got
+        tokens = list(rec.get("tokens") or ())
+        # through the loop thread: export_kv_page reads engine.cache,
+        # which the in-flight pipelined chain donates (run_device_op)
+        return self.run_device_op(
+            lambda: export_bundle(self.engine.kvpool, self.engine, tokens)
+        )
 
     # -- internals ----------------------------------------------------------
 
@@ -1881,6 +1975,14 @@ class ContinuousBatchingScheduler:
         for i, lane in enumerate(self._lanes):
             if lane.request is not None:
                 self._finish(i, lane.request, reason="cancelled")
+        # pending device ops resolve as failed, not as a timeout wait —
+        # an admin thread must never hang on a dead loop
+        with self._device_ops_lock:
+            pending = list(self._device_ops)
+            del self._device_ops[:]
+        for _fn, box, done in pending:
+            box["error"] = RuntimeError("scheduler stopped")
+            done.set()
         draining = self._draining.is_set()
         for req in self.queue.drain():
             if draining:
@@ -1906,6 +2008,9 @@ class ContinuousBatchingScheduler:
                 # serving resumes (the breaker stays open until a probe
                 # succeeds)
                 self._wd_abort.clear()
+            # step boundary: engine.cache is the live chain output here,
+            # so posted admin device ops (disagg export/import) run now
+            self._drain_device_ops()
             idle = all(l.request is None for l in self._lanes)
             # when every lane is free, park on the queue's condition variable
             # instead of spinning pop(timeout=0)+sleep — an idle server burns

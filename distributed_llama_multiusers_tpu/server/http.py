@@ -85,7 +85,8 @@ class ApiServer:
     def __init__(self, scheduler, tokenizer, model_name: str = "dllama",
                  template_type: TemplateType = TemplateType.UNKNOWN,
                  result_timeout_s: float = DEFAULT_RESULT_TIMEOUT_S,
-                 resume=None, replica_id: str | None = None):
+                 resume=None, replica_id: str | None = None,
+                 role: str = "mixed"):
         """``resume`` (serving/resume.StreamRegistry, built by dllama-api
         when ``--reconnect-grace`` > 0): streamed requests register their
         delta relay so a disconnected client can reattach within the
@@ -97,7 +98,14 @@ class ApiServer:
         ``serve()``): this replica's name in a fleet — stamped as the
         ``X-DLlama-Replica`` header on every response and onto the SSE
         terminal chunk, so fleet traces and the migration path can
-        attribute every shed and every stream to its source replica."""
+        attribute every shed and every stream to its source replica.
+
+        ``role`` (``--role``, default ``"mixed"``): this replica's
+        disaggregation role — ``"prefill"`` replicas take long-prompt
+        traffic and hand sessions off after first token,
+        ``"decode"``/``"mixed"`` replicas take the decode side.
+        Surfaced on ``GET /load`` so the router's scrape learns the
+        fleet topology instead of being configured twice."""
         self.scheduler = scheduler
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -105,6 +113,7 @@ class ApiServer:
         self.result_timeout_s = result_timeout_s
         self.resume = resume
         self.replica_id = replica_id
+        self.role = str(role or "mixed")
         self._httpd: ThreadingHTTPServer | None = None
         self._fallback_tel = None  # see _telemetry()
 
@@ -432,6 +441,7 @@ class ApiServer:
             ),
             "replica": self.replica_id,
             "model": self.model_name,
+            "role": self.role,
             "queue_depth": int(depth_fn()) if callable(depth_fn) else 0,
             "lanes_free": total - busy,
             "lanes_total": total,
@@ -576,6 +586,11 @@ class ApiServer:
                     # record (resolved seed included) + watermark, for a
                     # router to hand to another replica's /admin/migrate
                     self._export_session()
+                elif self.path.startswith("/admin/kvpages/"):
+                    # disaggregated prefill: a live session's committed
+                    # KV-page bundle (disagg/kvtransfer.py), for a router
+                    # to push to a decode replica's /admin/kvimport
+                    self._export_pages()
                 elif self.path == "/stats":
                     self._json(200, api.handle_stats())
                 elif self.path == "/metrics":
@@ -642,6 +657,94 @@ class ApiServer:
                     })
                     return
                 self._json(200, rec)
+
+            def _export_pages(self):
+                """``GET /admin/kvpages/<request_id>``: export a live
+                session's committed KV-page bundle (integrity-hashed,
+                ``disagg/kvtransfer.py``'s wire format) for disaggregated
+                prefill hand-off. 404 for unknown/finished requests, for
+                contiguous (non-paged) engines, and for schedulers
+                without the export surface — the router then degrades to
+                ticket-only migration, which re-prefills on the decode
+                replica instead of adopting pages."""
+                try:
+                    rid = int(self.path.rsplit("/", 1)[1])
+                except ValueError:
+                    self._json(400, {"error": "bad session id"})
+                    return
+                export = getattr(
+                    api.scheduler, "export_session_pages", None
+                )
+                try:
+                    bundle = export(rid) if callable(export) else None
+                except Exception as e:  # noqa: BLE001 — admin plane
+                    # answers JSON (e.g. a device-op timeout on a wedged
+                    # step); the router degrades to ticket-only migration
+                    self._json(503, {
+                        "error": f"kv page export failed: {e}",
+                        "reason": "export_failed",
+                        "request_id": rid,
+                    })
+                    return
+                if bundle is None:
+                    self._json(404, {
+                        "error": "no exportable kv pages "
+                                 "(unknown/finished session, or this "
+                                 "replica runs a contiguous kv cache)",
+                        "request_id": rid,
+                    })
+                    return
+                self._json(200, bundle)
+
+            def _admin_kvimport(self, body: dict):
+                """``POST /admin/kvimport``: verify + adopt a KV-page
+                bundle exported from another replica's
+                ``/admin/kvpages/<id>``. Every page hash re-verifies
+                BEFORE any pool mutation; adoption is refcount-correct
+                (``KVPagePool.adopt``) and pins the chain like a parked
+                session, so a following ``/admin/migrate`` of the same
+                session finds the prefix in the tree and prefills
+                tail-only. A pool-exhausted adoption answers the same
+                typed 429 + Retry-After shape every admission shed uses
+                (the router's fallback is the monolithic path — the
+                session is still live on the prefill replica)."""
+                from ..disagg.kvtransfer import KVTransferError, adopt_bundle
+                from ..runtime.kvpool import PoolExhausted
+
+                engine = getattr(api.scheduler, "engine", None)
+                pool = getattr(engine, "kvpool", None)
+                if pool is None:
+                    self._json(409, {
+                        "error": "kv import needs a paged engine "
+                                 "(--paged-kv) on this replica",
+                    })
+                    return
+                # through the scheduler loop's step boundary: the adopt
+                # mutates the pool and writes device pages, which must
+                # not race the pipelined chain's cache donation
+                run = getattr(api.scheduler, "run_device_op", None)
+                try:
+                    if callable(run):
+                        receipt = run(lambda: adopt_bundle(pool, engine, body))
+                    else:
+                        receipt = adopt_bundle(pool, engine, body)
+                except KVTransferError as e:
+                    # 422: the bundle itself is bad (corrupt, wrong
+                    # geometry) — NOT retryable against this payload
+                    self._json(422, {"error": str(e), "reason": e.reason})
+                    return
+                except PoolExhausted as e:
+                    self._reject(AdmissionRejected(
+                        "pool_exhausted", retry_after_s=2.0,
+                    ))
+                    del e
+                    return
+                except Exception as e:  # noqa: BLE001 — admin plane
+                    # answers JSON, never a raw handler stack trace
+                    self._json(500, {"error": str(e)})
+                    return
+                receipt["replica"] = api.replica_id
+                self._json(200, receipt)
 
             def _admin_migrate(self, body: dict):
                 """``POST /admin/migrate``: accept a session exported
@@ -779,7 +882,8 @@ class ApiServer:
                     ),
                 }
                 route = routes.get(self.path)
-                if route is None and self.path != "/admin/migrate":
+                admin = self.path in ("/admin/migrate", "/admin/kvimport")
+                if route is None and not admin:
                     self._json(404, {"error": "not found"})
                     return
                 try:
@@ -792,6 +896,10 @@ class ApiServer:
                     # fleet migration inject (see _admin_migrate): rides
                     # the same body parse, then the recovery path
                     self._admin_migrate(body)
+                    return
+                if self.path == "/admin/kvimport":
+                    # disagg page adoption (see _admin_kvimport)
+                    self._admin_kvimport(body)
                     return
                 build_fn, handle_fn = route
                 # request id in EVERY failure payload once a Request exists

@@ -257,6 +257,12 @@ class MockAsyncEngine:
                 [self.kvpool.table_row([])] * n_lanes, np.int32
             )
             self.page_copies_applied = 0  # the mocked device COW half
+            # disagg transfer mock: imported payloads keyed by page, each
+            # pinned to the tree node it was imported FOR (a reused page
+            # re-registered with different content falls back to the
+            # canonical derivation instead of replaying stale bytes)
+            self._page_payloads = {}
+            self.pages_imported = 0
 
     def max_chunk(self):
         return self._max_chunk
@@ -384,6 +390,41 @@ class MockAsyncEngine:
 
     def pool_stats(self):
         return self.kvpool.stats() if self.kvpool is not None else {}
+
+    def export_kv_page(self, page):
+        """The real engine's disagg export, mocked content-canonically:
+        a committed page's payload is a pure function of its block-
+        content chain (sha256 of the tree node key), so two replicas
+        that committed the same prefix export IDENTICAL bytes and the
+        kvtransfer integrity hashes are genuinely exercised end to end.
+        Imported pages replay the imported bytes (round-trip fidelity),
+        as long as the page still backs the node it was imported for."""
+        import hashlib
+
+        if self.kvpool is None:
+            raise RuntimeError("export_kv_page needs a paged engine")
+        key = self.kvpool.page_key(int(page))
+        if key is None:
+            raise ValueError(
+                f"page {int(page)} backs no committed block — only "
+                "immutable full blocks cross replicas"
+            )
+        got = self._page_payloads.get(int(page))
+        if got is not None and got[0] == key:
+            return got[1]
+        return hashlib.sha256(repr(key).encode("utf-8")).digest() * 2
+
+    def import_kv_page(self, page, payload):
+        """Mocked device half of a page import: record the bytes against
+        the node the page currently backs (adopt() registered it just
+        before this call — the same ordering the real engine gets from
+        the donated cache pytree)."""
+        if self.kvpool is None:
+            raise RuntimeError("import_kv_page needs a paged engine")
+        self._page_payloads[int(page)] = (
+            self.kvpool.page_key(int(page)), bytes(payload)
+        )
+        self.pages_imported += 1
 
     def reset_lane(self, lane):
         pass

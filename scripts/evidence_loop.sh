@@ -29,6 +29,53 @@ sys.exit(0 if plat == "tpu" else 1)
 EOF
 }
 
+# serving_disagg CPU-smoke leg: the phase is backend-free (mock-engine
+# replicas, same determinism class as pod_serving's fleet gate), so it
+# proves out BEFORE the tunnel wait instead of idling with it. The full
+# bench run repeats the phase; this leg exists so an unattended loop
+# still surfaces a disagg regression even when the tunnel never comes
+# up. Result keys — or the failure — are merged into the banked
+# artifact's phase_errors, the same slot NO_BACKEND lands in.
+SMOKE_OUT="$DIR/disagg_smoke_$(date +%Y%m%d_%H%M%S).out"
+BENCH_CHILD=1 BENCH_PHASE=serving_disagg BENCH_FORCE_CPU=1 GRAFT_SMALL=1 \
+  timeout 300 python bench.py > "$SMOKE_OUT" 2> "$SMOKE_OUT.err"
+SMOKE_RC=$?
+echo "serving_disagg cpu smoke rc=$SMOKE_RC ($SMOKE_OUT)"
+
+merge_disagg_smoke() {  # $1 = banked artifact (BENCH_LIVE.json)
+  python - "$SMOKE_OUT" "$SMOKE_RC" "$1" <<'EOF'
+import json, sys
+smoke_path, rc, live_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+result = None
+try:
+    for line in open(smoke_path):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+except OSError:
+    pass
+try:
+    with open(live_path) as f:
+        live = json.load(f)
+except Exception:
+    live = {}
+if rc == 0 and result is not None:
+    live.update({k: v for k, v in result.items()
+                 if k.startswith("serving_disagg")})
+    live["serving_disagg_cpu_smoke"] = "ok"
+else:
+    live["serving_disagg_cpu_smoke"] = "failed"
+    err = f"serving_disagg_cpu_smoke: rc={rc}"
+    prior = live.get("phase_errors", "")
+    live["phase_errors"] = (f"{prior}; {err}" if prior else err)[-600:]
+with open(live_path, "w") as f:
+    json.dump(live, f)
+EOF
+}
+
 attempt=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   attempt=$((attempt + 1))
@@ -43,6 +90,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   echo "bench rc=$?" >> "$OUT/status"
   if is_tpu_artifact "$OUT/bench.out"; then
     tail -1 "$OUT/bench.out" > "$REPO/BENCH_LIVE.json"
+    merge_disagg_smoke "$REPO/BENCH_LIVE.json"
     echo "TPU artifact banked" >> "$OUT/status"
     # bonus evidence while the tunnel is up; each has its own timeout
     timeout "${SWEEP_BUDGET_S:-1200}" python scripts/kernel_sweep.py 240 \

@@ -429,6 +429,42 @@ def test_shipped_manifest_is_current_and_stable(tmp_path):
     assert pinned["ops"]["OP_GRAMMAR"] == 13
 
 
+def test_kv_pages_op_cannot_land_without_version_bump(tmp_path):
+    """ISSUE 16's wire satellite: replaying the introduction of
+    OP_KV_PAGES against a lock that still pins the pre-disagg layout at
+    the SAME version is a finding naming the op — the new wire op
+    cannot land silently. With the pinned version differing (the v4->v5
+    bump that actually shipped with it), the manifest checker stands
+    down: the bump IS the landing permit."""
+    dst = tmp_path / "parallel" / "multihost.py"
+    dst.parent.mkdir(parents=True)
+    shutil.copy(MULTIHOST, dst)
+    manifest = manifest_from_model(real_model())
+    # rot-guard: the disagg op is part of the pinned surface
+    assert manifest["ops"]["OP_KV_PAGES"] == 14
+    assert manifest["encoders"]["send_kv_pages"] == "OP_KV_PAGES"
+
+    stale = json.loads(render_manifest(manifest))
+    del stale["ops"]["OP_KV_PAGES"]
+    del stale["encoders"]["send_kv_pages"]
+    del stale["payload_slots"]["send_kv_pages"]
+    lock = tmp_path / "analysis" / "protocol.lock"
+    lock.parent.mkdir(parents=True, exist_ok=True)
+    lock.write_text(render_manifest(stale), encoding="utf-8")
+    findings = [f for f in run_on(tmp_path, {})
+                if f.check == "protocol-manifest"]
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "without a PROTOCOL_VERSION bump" in findings[0].message
+    assert "OP_KV_PAGES" in findings[0].message
+
+    # the sanctioned path: the pre-disagg lock pinned v4, the op landed
+    # with the bump to v5 + a regenerated lock in the same diff
+    stale["protocol_version"] = manifest["protocol_version"] - 1
+    lock.write_text(render_manifest(stale), encoding="utf-8")
+    findings = run_on(tmp_path, {})
+    assert [f for f in findings if f.check == "protocol-manifest"] == []
+
+
 def test_cli_update_manifest_roundtrip_relints_clean(tmp_path, capsys):
     """`dlint --update-protocol-manifest` over a copied tree reproduces
     the shipped lock, and the copied protocol file re-lints clean
