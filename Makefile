@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint lockgraph protocol jitcheck hooks sanitize dryrun chaos fleet clean
+.PHONY: all native test verify lint lockgraph protocol jitcheck leakcheck hooks sanitize dryrun chaos fleet clean
 
 all: native
 
@@ -114,6 +114,24 @@ protocol:
 jitcheck:
 	python -m distributed_llama_multiusers_tpu.analysis --jit-table
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_jitcheck.py -q
+
+# Resource-lifecycle gate (docs/LINT.md "resource-balance" /
+# "device-affinity" + "The runtime leak witness", ISSUE 17): prints the
+# extracted lifecycle surface — every declared resource kind with its
+# acquire/release vocabulary and transitive releaser closure, the
+# device-affine methods, the batching-loop roots (the reviewer aid for
+# new acquire/release pairs; `--graph resources` draws the same surface
+# as DOT) — then runs the witness suite: a clean scheduler stop must
+# hold NOTHING, and a deliberately leaked registry entry must make
+# DLLAMA_LEAKCHECK=1 RAISE at the drain point. (The suite drives both
+# strict and counter-only modes itself via leakcheck.force; its slow
+# subprocess fixture reruns the serving+prefix suites under
+# DLLAMA_LEAKCHECK=1 end to end.) Run it before shipping scheduler/
+# pool/registry lifecycle changes; the static checks ride `make lint`,
+# and every bench serving phase asserts leaked_resources == 0.
+leakcheck:
+	python -m distributed_llama_multiusers_tpu.analysis --resource-table
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_leakcheck.py -q
 
 # Install the git pre-commit hook running the diff-proportional lint
 # (`dlint --changed`, docs/LINT.md) so findings surface at commit time

@@ -614,7 +614,29 @@ def _serve_batch(config, params, n_lanes, max_tokens):
     step_times.clear()
     engine.stats.reset()  # spec counters must cover the measured batch only
     toks, wall = run_batch()
+    _drained_report("serve_batch", sched)
     return toks / wall, np.sort(np.asarray(step_times)), engine.stats
+
+
+def _drained_report(phase, sched, pre_pages=0):
+    """``leaked_resources == 0`` beside ``compiles_after_warmup == 0``
+    (ISSUE 17): after stop() the leak witness's drain snapshot
+    (scheduler.leak_counts() — session mirrors, pending device ops, open
+    journal marks, lane-held KV pages) must be all-zero, with
+    ``pool_pages_in_use`` back at its pre-phase count. Asserted, not just
+    reported: a phase that leaked measured a dirtier steady state than
+    the number it banked claims."""
+    counts = sched.leak_counts()
+    leaked = {
+        k: v for k, v in counts.items()
+        if v != (pre_pages if k == "kv_lane_pages" else 0)
+    }
+    assert not leaked, (
+        f"{phase}: resources still held after stop: {leaked} "
+        "(rerun under DLLAMA_LEAKCHECK=1 to raise at the exact drain "
+        "point; docs/LINT.md resource-balance names the static twin)"
+    )
+    return {f"{phase}_leaked_resources": 0}
 
 
 def _phase_serving(config, small):
@@ -652,6 +674,7 @@ def _phase_serving(config, small):
         "serving_step_ms_p50": round(float(lat[len(lat) // 2]) * 1e3, 2),
         "serving_step_ms_p95": round(float(lat[int(len(lat) * 0.95)]) * 1e3, 2),
         "serving_requests": 8,
+        "serving_leaked_resources": 0,  # asserted in _serve_batch
         # speculation acceptance over the measured batch, per (DRAFTED
         # lane, verify-step): 1.0 = no draft accepted, K+1 = full
         # acceptance. Sampled/draft-less lanes are excluded from both
@@ -776,6 +799,7 @@ def _phase_serving_churn(config, small):
     warmup_engine(engine, spec=True, multi_step=sched.multi_step)
 
     toks, wall = _run_churn(sched, n_requests, max_tokens)
+    drained = _drained_report("serving_churn", sched)
     stats = engine.stats.snapshot()
     # compile-stability evidence (ISSUE 15): warmup armed the recompile
     # witness (analysis/jitcheck.py), so this is the MEASURED count of
@@ -865,6 +889,7 @@ def _phase_serving_churn(config, small):
             "jit_compiles_after_warmup"
         ],
         "serving_churn_prefix_hits": stats["prefix_hits"],
+        **drained,
         **trace_extra,
     }
 
@@ -929,6 +954,10 @@ def _phase_serving_prefix(config, small):
         return _orig_copy_lane(src, dst, prefix_len=prefix_len)
 
     engine.copy_lane = _counting_copy_lane
+    # pre-phase lane-page occupancy: the drain check below asserts the
+    # pool returns exactly here (parked pages are intentionally resident
+    # and excluded from pool_pages_in_use by construction)
+    pre_pages = engine.pool_stats().get("pool_pages_in_use", 0)
     tokenizer = CharStreamTokenizer(config.vocab_size, max_chars=96)
     telemetry = Telemetry()
     sched = ContinuousBatchingScheduler(engine, tokenizer,
@@ -974,6 +1003,7 @@ def _phase_serving_prefix(config, small):
         rebuild_ttft_ms = ttft_one()
     finally:
         sched.stop()
+    drained = _drained_report("serving_prefix", sched, pre_pages)
     stats = engine.stats.snapshot()
     pool = engine.pool_stats()
 
@@ -1034,6 +1064,7 @@ def _phase_serving_prefix(config, small):
         "serving_prefix_pipeline_flushes": stats["pipeline_flushes"],
         "serving_prefix_prefix_hits": stats["prefix_hits"],
         "serving_prefix_prefix_tokens_saved": stats["prefix_tokens_saved"],
+        **drained,
     }
 
 
@@ -1112,6 +1143,7 @@ def _phase_pod_serving(config, small):
     coll = engine.collective_stats()
 
     toks, wall = _run_churn(sched, n_requests, max_tokens)
+    drained = _drained_report("pod_serving", sched)
     # snapshot BEFORE the sync probe below: the probe is diagnostics and
     # must not blur the serving window's compile-stability evidence
     stats = engine.stats.snapshot()
@@ -1166,6 +1198,7 @@ def _phase_pod_serving(config, small):
         "pod_serving_sync_ms": sync.get("sync_ms"),
         "pod_serving_sync_frac": sync.get("sync_frac"),
         "pod_serving_sync_source": sync.get("source"),
+        **drained,
     }
 
 
@@ -1270,6 +1303,9 @@ def _phase_serving_faults(config, small):
     finally:
         faults.disarm()
         sched.stop()
+    # the chaos twin of ring-drained: even with a fault mid-dispatch,
+    # containment released every mirror/page/op the failed lanes held
+    drained = _drained_report("serving_faults", sched)
 
     outcomes: dict[str, int] = {}
     for r in submitted:
@@ -1299,6 +1335,7 @@ def _phase_serving_faults(config, small):
         "serving_faults_breaker_trips": br["breaker_trips"],
         "serving_faults_ring_drained": engine.pipeline_inflight() == 0,
         "serving_faults_wall_s": round(wall, 2),
+        **drained,
     }
 
 
@@ -1394,6 +1431,7 @@ def _phase_serving_recovery(config, small):
     for rq in refs:
         rq.future.result(timeout=300)
     sched.stop()
+    _drained_report("serving_recovery_ref", sched)
 
     # -- crash run: journal on, die mid-stream -------------------------------
     journal_path = os.path.join(
@@ -1430,6 +1468,10 @@ def _phase_serving_recovery(config, small):
     journal.flush()
     journal.close()
     sched.stop()
+    # the crash image's open marks live in the DETACHED journal (the
+    # whole point); the scheduler's own resources must still settle —
+    # stop() is a clean shutdown standing in for the process dying
+    _drained_report("serving_recovery_crash", sched)
     pre_tokens = sum(len(v) for v in pre.values())
     incomplete = read_journal(journal_path).incomplete()
 
@@ -1478,6 +1520,7 @@ def _phase_serving_recovery(config, small):
         t.join(timeout=300)
     sched.stop()
     registry.close()
+    drained = _drained_report("serving_recovery", sched)
 
     # -- reconcile: the client view vs the uninterrupted streams -------------
     lost = dup = 0
@@ -1515,6 +1558,7 @@ def _phase_serving_recovery(config, small):
         "serving_recovery_byte_identical": identical,
         "serving_recovery_journal_records": jstats.records,
         "serving_recovery_journal_torn_tail": jstats.torn,
+        **drained,
     }
 
 
@@ -1620,6 +1664,7 @@ def _phase_serving_structured(config, small):
             r.future.result(timeout=600)
     finally:
         sched.stop()
+    drained = _drained_report("serving_structured", sched)
     wall = time.perf_counter() - t0
     assert all(r.error is None for r in reqs), [r.error for r in reqs]
 
@@ -1688,6 +1733,11 @@ def _phase_serving_structured(config, small):
         replayed = re_req.future.result(timeout=120)
     finally:
         sched2.stop()
+    # all three replay schedulers drain clean too — the crash stand-in's
+    # force-cancel journals its finish, so even ITS marks close
+    for tag, s in (("ref", ref_sched), ("crash", crash_sched),
+                   ("replay", sched2)):
+        _drained_report(f"serving_structured_{tag}", s)
 
     return {
         "phase": "serving_structured",
@@ -1710,6 +1760,7 @@ def _phase_serving_structured(config, small):
         "structured_replay_identical": bool(
             replayed == ref_text and json.loads(replayed)
         ),
+        **drained,
     }
 
 
@@ -1930,13 +1981,15 @@ def _phase_serving_fleet(config, small):
     mig_p50 = mig_hist.quantile(0.5) if mig_hist.count else None
     router.close()
     rhttpd.shutdown()
+    fleet_drained = True
     for r in replicas:
         try:
             r["httpd"].shutdown()
             r["registry"].close()
             r["sched"].stop()
+            _drained_report(f"serving_fleet_{r['rid']}", r["sched"])
         except RuntimeError:
-            pass
+            fleet_drained = False  # a hung stop can't certify its drain
     affinity_routes = max(1, stats["fleet_affinity_routes"])
     return {
         "serving_fleet_replicas": 3,
@@ -1984,6 +2037,9 @@ def _phase_serving_fleet(config, small):
         "serving_fleet_lost_chars": lost,
         "serving_fleet_duplicate_chars": dup,
         "serving_fleet_byte_identical": byte_identical,
+        # per-replica leak_counts() asserted zero above — the drained
+        # replica AND the killed one both released every mirror/page
+        "serving_fleet_leaked_resources": 0 if fleet_drained else None,
     }
 
 
@@ -2239,6 +2295,10 @@ def _phase_serving_disagg(config, small):
             r["httpd"].shutdown()
             r["registry"].close()
             r["sched"].stop()
+            # the decode replica ADOPTED transferred pages mid-phase: its
+            # pool must still drain to zero lane-held pages (adopted
+            # pages park or free with their session like native ones)
+            _drained_report(f"serving_disagg_{r['rid']}", r["sched"])
         except RuntimeError:
             pass
     long_ttft_ms = (
@@ -2268,6 +2328,7 @@ def _phase_serving_disagg(config, small):
         "serving_disagg_byte_identical": True,  # asserted above
         "serving_disagg_monolithic_fallback_ok": True,  # asserted above
         "serving_disagg_compiles_after_warmup": 0,  # asserted above
+        "serving_disagg_leaked_resources": 0,  # asserted per replica above
     }
 
 

@@ -8,7 +8,7 @@ reviewer memory. This package machine-checks them — the Python/JAX
 analogue of the reference repo's sanitizer CI for C++ (SURVEY.md §5.2,
 mirrored by ``make sanitize``).
 
-Sixteen checks (docs/LINT.md has the full contract and waiver policy).
+Eighteen checks (docs/LINT.md has the full contract and waiver policy).
 The four ``lock-*``/``pod-*`` checks are the v2 cross-file concurrency
 layer: they share one lock model (lockgraph.py) of every class-qualified
 lock in the package, and the statically computed lock-order graph doubles
@@ -21,7 +21,14 @@ over the journal/recovery/migration/grammar replay closure. The
 stability layer: a device-program surface model of ``runtime/engine.py``
 (jitmodel.py — every ``jax.jit`` site, step-family binding, dispatcher,
 and what ``warmup_engine`` warms), paired with the runtime recompile
-witness (jitcheck.py, ``DLLAMA_JITCHECK=1``).
+witness (jitcheck.py, ``DLLAMA_JITCHECK=1``). The ``resource-balance``/
+``device-affinity`` checks are the v5 resource-lifecycle layer: a
+cross-file acquire/release surface model (resourcemodel.py — kvpool
+pages, stream-registry entries, journal marks, the scheduler's session
+mirror, declared in-source via ``_dlint_acquires``/``_dlint_releases``
+beside ``_dlint_guarded_by``), paired with the runtime leak witness
+(leakcheck.py, ``DLLAMA_LEAKCHECK=1``) that counts — and in strict mode
+raises at — resources still held after a drain/stop.
 
 - ``lock-order``     — the cross-file "held while acquiring" graph over
   declared locks stays acyclic (one level of intra-package calls
@@ -50,6 +57,12 @@ witness (jitcheck.py, ``DLLAMA_JITCHECK=1``).
   the donated operand from the call's results; no use-after-donate
 - ``warmup-coverage`` — every dispatchable compiled step family is
   warmed by ``warmup_engine``, bucketed families per prefill bucket
+- ``resource-balance`` — every acquire of a declared resource kind
+  (kv pages, registry entries, journal marks, session-mirror records)
+  is released on all exception paths; intentional transfers carry
+  ``ok[resource-balance]`` waivers
+- ``device-affinity`` — declared donated-device-pytree touchers run
+  only on the batching loop or through ``scheduler.run_device_op()``
 - ``host-sync``      — explicit, waived device->host transfers in decode
 - ``pipeline-sync``  — NO host syncs at all in the async-pipeline dispatch
   half (engine.decode_pipelined / scheduler._pipeline_dispatch)

@@ -71,11 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(`make lint` picks github when GITHUB_ACTIONS=true)",
     )
     ap.add_argument(
-        "--graph", action="store_true",
-        help="dump the computed lock-order graph (DOT) and exit — nodes "
-        "are class-qualified locks, edges are 'held while acquiring' "
-        "sites, waived edges dashed; reviewers of new lock code eyeball "
-        "the new edges here",
+        "--graph", nargs="?", const="locks", default=None,
+        choices=("locks", "resources"), metavar="MODE",
+        help="dump a computed surface graph (DOT) and exit — 'locks' "
+        "(the default when bare) draws the lock-order graph: nodes are "
+        "class-qualified locks, edges 'held while acquiring' sites, "
+        "waived edges dashed; 'resources' draws the lifecycle flow: "
+        "acquire methods -> resource kinds -> release methods, with "
+        "ok[resource-balance] transfers as dashed edges",
     )
     ap.add_argument(
         "--changed", nargs="?", const="HEAD", default=None, metavar="REF",
@@ -94,6 +97,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="print the extracted pod wire-protocol op table plus the "
         "diff vs the pinned manifest, and exit — the reviewer aid for "
         "packet-layout changes (`make protocol`)",
+    )
+    ap.add_argument(
+        "--resource-table", action="store_true",
+        help="print the extracted resource-lifecycle surface — every "
+        "declared kind with its acquire/release vocabulary and "
+        "transitive releaser closure, the device-affine methods, and "
+        "the batching-loop roots — and exit; the reviewer aid for new "
+        "acquire/release pairs (`make leakcheck`)",
     )
     ap.add_argument(
         "--jit-table", action="store_true",
@@ -272,6 +283,47 @@ def _jit_table(paths: list[Path]) -> int:
     return 0
 
 
+def _resource_table(paths: list[Path]) -> int:
+    from .resourcemodel import build_model
+
+    model = build_model(paths)
+    if not model.kinds and not model.device_methods:
+        print("dlint: no _dlint_acquires/_dlint_device_affine "
+              "declarations under the given paths", file=sys.stderr)
+        return 2
+    n_scoped = sum(
+        1 for fn in model.functions
+        for decl in model.kinds.values()
+        if fn.name not in decl.vocabulary
+        and {c.name for c in fn.calls} & set(decl.acquires)
+    )
+    print(f"resource surface: {len(model.kinds)} kind(s), "
+          f"{len(model.device_methods)} device-affine method(s), "
+          f"{n_scoped} acquiring function(s) in scope")
+    for kind in sorted(model.kinds):
+        decl = model.kinds[kind]
+        releasers = model.transitive_releasers(kind)
+        wrappers = sorted(releasers - set(decl.releases))
+        print(f"\nkind {kind!r}")
+        for m, site in sorted(decl.acquires.items()):
+            print(f"  acquire  {m:24s} {site}")
+        for m, site in sorted(decl.releases.items()):
+            print(f"  release  {m:24s} {site}")
+        if wrappers:
+            print(f"  via      {', '.join(wrappers)}")
+    if model.device_methods:
+        print("\ndevice-affine (loop thread or run_device_op only):")
+        for m, site in sorted(model.device_methods.items()):
+            print(f"  {m:26s} {site}")
+    for (path, cls), roots in sorted(model.loop_roots.items()):
+        closure = sorted(model.loop_closure(path, cls))
+        print(f"\nloop roots {cls} ({path}): {', '.join(roots)}")
+        print(f"  closure: {len(closure)} method(s)")
+    print("\n(runtime twin: DLLAMA_LEAKCHECK=1 raises at the drain "
+          "point — docs/LINT.md)")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     checkers = default_checkers()
@@ -298,7 +350,14 @@ def main(argv=None) -> int:
         return _protocol_table(paths)
     if args.jit_table:
         return _jit_table(paths)
+    if args.resource_table:
+        return _resource_table(paths)
     analyzer = Analyzer(checkers)
+    if args.graph == "resources":
+        from .resourcemodel import build_model, resource_dot
+
+        print(resource_dot(build_model(paths)))
+        return 0
     if args.graph:
         model = scan_paths(paths, valid_checks=analyzer.valid_checks)
         model.ensure_semantics()

@@ -96,6 +96,9 @@ class Project:
         # lock-order checker's collect pass and shared by every
         # concurrency check; None until that collect has run
         self.lock_model = None
+        # cross-file resource-lifecycle model (analysis/resourcemodel.py),
+        # built by the v5 checkers' collect passes; None until one has run
+        self.resource_model = None
         # findings raised during collect (malformed declarations)
         self.collect_findings: list[Finding] = []
 

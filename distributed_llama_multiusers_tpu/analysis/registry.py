@@ -1,4 +1,4 @@
-"""Checker registry: the sixteen project-invariant checks, in report order.
+"""Checker registry: the eighteen project-invariant checks, in report order.
 
 Order matters for collection: the lock-order checker's collect pass
 builds the shared cross-file lock model (``project.lock_model``) that
@@ -27,6 +27,7 @@ from .lock_check import GuardedByChecker
 from .lock_order_check import LockOrderChecker
 from .pipeline_check import PipelineSyncChecker
 from .protocol_check import ProtocolChecker, ProtocolManifestChecker
+from .resource_check import DeviceAffinityChecker, ResourceBalanceChecker
 from .sharding_check import ShardingAxisChecker
 
 ALL_CHECKERS = (
@@ -41,6 +42,8 @@ ALL_CHECKERS = (
     JitStabilityChecker,
     DonationDisciplineChecker,
     WarmupCoverageChecker,
+    ResourceBalanceChecker,
+    DeviceAffinityChecker,
     HostSyncChecker,
     PipelineSyncChecker,
     ClockChecker,

@@ -1,0 +1,288 @@
+"""dlint v5 checks: resource-balance and device-affinity.
+
+Both consume the resource-lifecycle surface model
+(analysis/resourcemodel.py) and emit from ``finalize`` — the analyses
+are cross-file by construction (transitive releasers span modules, call
+sites of a leaky function live anywhere), so like lock-order they only
+exist once every file has been seen. A pleasant corollary: ``--changed``
+runs always report them in full, because finalize findings are never
+filtered to the changed set.
+
+**resource-balance** — every function that directly acquires a declared
+resource kind (calls a ``_dlint_acquires`` method) must not let an
+exception escape with the resource still held. A ``raise`` lexically
+after the first acquire is a finding unless one of these holds:
+
+1. it sits in an ``except`` arm of the try whose BODY contains the
+   acquire itself (the acquire may be what failed — nothing is held);
+2. a release of the kind (directly or via any transitive releaser
+   wrapper, e.g. ``_fail_request`` -> ``_paged_release`` ->
+   ``paged_finish``) appears lexically between the acquire and the
+   raise;
+3. the raise is in the BODY of a try one of whose handlers calls a
+   releaser of the kind (cleanup-at-catch);
+4. interprocedural: the function has at least one call site in the
+   package and EVERY call site sits inside a try whose handler calls a
+   transitive releaser of the kind — the owner one frame up releases on
+   failure (the scheduler's ``_claim_next`` / ``_start_request`` shape);
+5. an ``ok[resource-balance]`` waiver marks the raise as an intentional
+   transfer (park hand-off, migration ticket).
+
+A plain ``return`` is never flagged: returning an acquired resource IS
+ownership transfer, the normal API shape (``register`` returning its
+relay, ``paged_admit`` returning the prefix start).
+
+**device-affinity** — calls to ``_dlint_device_affine`` methods (the
+donated-device-pytree touchers) are legal only:
+
+1. inside the file that declared them (the engine façade calls its own
+   halves);
+2. inside a lambda passed to ``scheduler.run_device_op`` (or a local
+   alias of it) — the sanctioned cross-thread funnel;
+3. from a method in the batching-loop closure (the ``_dlint_loop_roots``
+   fixpoint over same-class ``self.X()`` calls);
+4. from an engine-facade class — one that defines at least one
+   same-named device-affine method itself (the pod's RootControlEngine
+   proxies replicate every device call to workers; the scheduler holds
+   the facade AS its engine, so facade method bodies run exactly where
+   the declaring engine's do);
+5. from a function whose EVERY package call site is itself legal under
+   these rules (the disagg export/import helpers, reached only through
+   ``run_device_op`` lambdas);
+6. under an ``ok[device-affinity]`` waiver (the pod worker's replay
+   loop IS its host's batching thread).
+
+This mechanizes the race PR 16 caught live: an admin/HTTP thread
+touching ``engine.cache`` while the loop's next dispatch has already
+donated it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Finding, Project, SourceFile, last_component, waived
+from .resourcemodel import (
+    CallSite,
+    FuncInfo,
+    ResourceModel,
+    ingest_file,
+    project_model,
+)
+
+
+class ResourceBalanceChecker(Checker):
+    name = "resource-balance"
+    description = (
+        "every acquire of a declared resource kind must be released on "
+        "all exception paths (transfers via return are ownership moves; "
+        "intentional transfers at a raise need ok[resource-balance])"
+    )
+
+    def collect(self, sf: SourceFile, project: Project) -> None:
+        ingest_file(project_model(project), sf, project)
+
+    def finalize(self, project: Project):
+        model = project_model(project)
+        for kind in sorted(model.kinds):
+            decl = model.kinds[kind]
+            if not decl.acquires or not decl.releases:
+                # half-declared vocabulary: nothing can ever balance, so
+                # flag the declaration rather than every acquire site
+                site = next(
+                    iter(decl.acquires.values()),
+                    next(iter(decl.releases.values()), "?"),
+                )
+                yield Finding(
+                    self.name, site.split("(", 1)[-1].rstrip(")"), 0,
+                    f"resource kind {kind!r} declares "
+                    f"{'no acquire' if not decl.acquires else 'no release'}"
+                    " methods — pair _dlint_acquires with _dlint_releases",
+                )
+                continue
+            vocab = decl.vocabulary
+            acquire_names = frozenset(decl.acquires)
+            releasers = model.transitive_releasers(kind)
+            for fn in model.functions:
+                if fn.name in vocab:
+                    continue  # vocabulary implementations and proxies
+                acq = [c for c in fn.calls if c.name in acquire_names]
+                if not acq:
+                    continue
+                first_acq = min(c.line for c in acq)
+                acq_name = min(acq, key=lambda c: c.line).name
+                release_lines = [
+                    c.line for c in fn.calls if c.name in releasers
+                ]
+                sites_excused = None  # computed lazily, once per fn/kind
+                for rs in fn.raises:
+                    if rs.line <= first_acq:
+                        continue
+                    if self._handler_of_acquire_try(rs, acq):
+                        continue
+                    if any(first_acq < rl < rs.line for rl in release_lines):
+                        continue
+                    if self._releasing_handler_below(model, rs, releasers):
+                        continue
+                    if sites_excused is None:
+                        sites_excused = self._call_sites_release(
+                            model, fn, releasers
+                        )
+                    if sites_excused:
+                        continue
+                    yield Finding(
+                        self.name, fn.path, rs.line,
+                        f"'{fn.qual}' raises with a {kind} acquired via "
+                        f"{acq_name}() still held — no release reaches "
+                        "this exception path (release it, or waive an "
+                        "intentional transfer with ok[resource-balance])",
+                    )
+
+    @staticmethod
+    def _handler_of_acquire_try(rs, acq: list[CallSite]) -> bool:
+        """Excuse 1: the raise's own except arm belongs to the try whose
+        body holds the acquire — the acquire itself may have failed."""
+        t = rs.handler_try
+        if t is None or not t.handlers:
+            return False
+        body_start = t.body[0].lineno
+        body_end = t.handlers[0].lineno
+        return any(body_start <= c.line < body_end for c in acq)
+
+    @staticmethod
+    def _handler_calls(model: ResourceModel, t, names: frozenset[str] | set[str]) -> bool:
+        for h in t.handlers:
+            for node in ast.walk(h):
+                if isinstance(node, ast.Call):
+                    if last_component(node.func) in names:
+                        return True
+        return False
+
+    def _releasing_handler_below(self, model, rs, releasers) -> bool:
+        """Excuse 3: some enclosing try will catch this raise and its
+        handler releases the kind."""
+        return any(
+            self._handler_calls(model, t, releasers) for t in rs.body_trys
+        )
+
+    def _call_sites_release(
+        self, model: ResourceModel, fn: FuncInfo, releasers: set[str]
+    ) -> bool:
+        """Excuse 4: every package call site of ``fn`` sits inside a try
+        whose handler transitively releases the kind."""
+        sites = [
+            c
+            for g in model.functions
+            if g is not fn
+            for c in g.calls
+            if c.name == fn.name
+        ]
+        if not sites:
+            return False
+        return all(
+            any(self._handler_calls(model, t, releasers) for t in c.body_trys)
+            for c in sites
+        )
+
+
+class DeviceAffinityChecker(Checker):
+    name = "device-affinity"
+    description = (
+        "_dlint_device_affine methods (donated device pytree touchers) "
+        "may only run on the batching loop or through "
+        "scheduler.run_device_op()"
+    )
+
+    def collect(self, sf: SourceFile, project: Project) -> None:
+        ingest_file(project_model(project), sf, project)
+
+    def finalize(self, project: Project):
+        model = project_model(project)
+        if not model.device_methods:
+            return
+        closures = {
+            key: model.loop_closure(*key) for key in model.loop_roots
+        }
+
+        def in_closure(fn: FuncInfo) -> bool:
+            return (
+                fn.cls is not None
+                and fn.name in closures.get((fn.path, fn.cls), ())
+            )
+
+        def call_waived(fn: FuncInfo, c: CallSite) -> bool:
+            sf = model.files.get(fn.path)
+            if sf is None:
+                return False
+            return waived(sf, Finding(self.name, fn.path, c.line, ""))
+
+        # engine facades: classes defining any declared device-affine
+        # method are part of the engine surface itself (RootControlEngine,
+        # test engines) — their method bodies inherit the engine's
+        # affinity contract, since callers reach them through the same
+        # `engine.X()` dispatch the declaring engine gets
+        facades = {
+            (path, cls)
+            for path, classes in model.class_methods.items()
+            for cls, methods in classes.items()
+            if methods & set(model.device_methods)
+        }
+
+        def direct_ok(fn: FuncInfo, c: CallSite) -> bool:
+            if fn.path in model.device_decl_paths:
+                return True
+            if c.in_funnel_arg:
+                return True
+            if in_closure(fn):
+                return True
+            if fn.cls is not None and (fn.path, fn.cls) in facades:
+                return True
+            return False
+
+        # offending device calls, grouped by containing function
+        offenders: dict[str, list[tuple[FuncInfo, CallSite]]] = {}
+        for fn in model.functions:
+            for c in fn.calls:
+                if c.name not in model.device_methods:
+                    continue
+                if direct_ok(fn, c):
+                    continue
+                offenders.setdefault(fn.name, []).append((fn, c))
+
+        # caller-legality fixpoint (rule 5): a function whose every
+        # package call site is itself in a legal context inherits
+        # legality — waived call sites count (the waiver carries the
+        # justification)
+        legal_funcs: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name in list(offenders):
+                if name in legal_funcs:
+                    continue
+                sites = [
+                    (g, c)
+                    for g in model.functions
+                    for c in g.calls
+                    if c.name == name and g.name != name
+                ]
+                if sites and all(
+                    direct_ok(g, c) or g.name in legal_funcs
+                    or call_waived(g, c)
+                    for g, c in sites
+                ):
+                    legal_funcs.add(name)
+                    changed = True
+
+        for name in sorted(offenders):
+            if name in legal_funcs:
+                continue
+            for fn, c in offenders[name]:
+                yield Finding(
+                    self.name, fn.path, c.line,
+                    f"'{c.name}' called from '{fn.qual}' off the batching "
+                    "loop — donated device pytrees may only be touched on "
+                    "the loop thread or through scheduler.run_device_op() "
+                    f"(declared device-affine by "
+                    f"{model.device_methods[c.name]})",
+                )

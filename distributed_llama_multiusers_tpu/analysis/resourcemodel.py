@@ -1,0 +1,506 @@
+"""dlint v5: the resource-lifecycle surface model.
+
+Every latent-bug family this package has actually shipped — the PR 10
+registry-entry-per-shed and journal-mark-per-stream leaks, PR 11's three
+rounds of refcount-pin fixes, PR 16's admin thread racing the donated
+cache pytree — is a *lifecycle* bug: an acquire whose release (or whose
+thread-affinity contract) silently lost an exit path. This module
+extracts that lifecycle surface from the AST, exactly as
+analysis/lockgraph.py does for locks and analysis/jitmodel.py does for
+compiled programs, so the two v5 checks (analysis/resource_check.py) and
+the reviewer table (``--resource-table``) all read one model.
+
+A class declares its pairing vocabulary in-source with plain
+(non-annotated, so dataclasses ignore them) class attributes beside the
+existing ``_dlint_guarded_by``:
+
+    class KVPagePool:
+        _dlint_acquires = {"kv-page": ("admit", "adopt")}
+        _dlint_releases = {"kv-page": ("finish", "release", "reset")}
+
+    class InferenceEngine:
+        _dlint_device_affine = ("apply_paged_admit", "copy_lane", ...)
+
+    class ContinuousBatchingScheduler:
+        _dlint_loop_roots = ("_run",)
+
+- ``_dlint_acquires`` / ``_dlint_releases`` — ``{kind: (method, ...)}``:
+  calling an acquire method of *kind* takes ownership of one resource of
+  that kind; calling a release method (directly or through any wrapper
+  that transitively reaches one) gives it back. Method names must be
+  distinctive within the package (same name-matching contract as
+  guarded-by); declarations are rot-guarded — naming a method the class
+  does not define is itself a finding.
+- ``_dlint_device_affine`` — methods that touch donated device pytrees;
+  legal only from the batching loop or through ``run_device_op`` (the
+  device-affinity check owns the legality rules).
+- ``_dlint_loop_roots`` — the batching-loop entry points; the set of
+  same-class methods reachable from them (via ``self.X()`` calls, to a
+  fixpoint) IS the loop-thread closure device-affine calls may live in.
+
+The model is name-based and lexical, no type inference — the same
+deliberate trade guarded-by makes: distinctive method names buy a
+cross-file analysis that runs on bare CPython in milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Finding, Project, SourceFile, last_component
+
+ACQUIRE_DECL_NAME = "_dlint_acquires"
+RELEASE_DECL_NAME = "_dlint_releases"
+DEVICE_DECL_NAME = "_dlint_device_affine"
+LOOP_DECL_NAME = "_dlint_loop_roots"
+
+# the sanctioned cross-thread funnel for device-affine calls
+# (runtime/scheduler.py run_device_op); a lambda/def passed as an
+# argument to it executes ON the batching loop at a step boundary
+DEVICE_FUNNEL = "run_device_op"
+
+
+@dataclass
+class KindDecl:
+    """One resource kind's pairing vocabulary, merged across classes
+    (kv-page spans KVPagePool and the engine's paged_* façade)."""
+
+    kind: str
+    acquires: dict[str, str] = field(default_factory=dict)  # method -> site
+    releases: dict[str, str] = field(default_factory=dict)  # method -> site
+
+    @property
+    def vocabulary(self) -> frozenset[str]:
+        return frozenset(self.acquires) | frozenset(self.releases)
+
+
+@dataclass
+class CallSite:
+    """One call expression, recorded with the lexical context the checks
+    need: is it inside a closure handed to run_device_op, and which
+    try/except arms surround it."""
+
+    name: str  # callee last component
+    line: int
+    in_funnel_arg: bool  # inside a lambda/def that is an argument to run_device_op
+    # Trys whose BODY lexically contains this call, innermost first —
+    # the interprocedural excuse asks whether a call site's enclosing
+    # try has a releasing handler
+    body_trys: tuple[ast.Try, ...] = ()
+
+
+@dataclass
+class RaiseSite:
+    line: int
+    # the Try whose HANDLER lexically contains this raise (None if the
+    # raise is not inside an except arm); Python semantics: a raise in a
+    # handler is NOT caught by its own try
+    handler_try: ast.Try | None
+    # Trys whose BODY contains this raise, innermost first — their
+    # handlers will catch it
+    body_trys: tuple[ast.Try, ...]
+
+
+@dataclass
+class FuncInfo:
+    """One function/method (lambdas fold into their enclosing def — a
+    lambda body cannot contain a raise statement or an acquire-with-
+    later-raise shape, so per-call funnel flags carry all we need)."""
+
+    path: str  # display path
+    name: str
+    qual: str  # Class.method or bare function name
+    line: int
+    cls: str | None
+    node: ast.AST
+    calls: list[CallSite] = field(default_factory=list)
+    self_calls: set[str] = field(default_factory=set)  # self.X(...) callees
+    raises: list[RaiseSite] = field(default_factory=list)
+
+    def call_names(self) -> set[str]:
+        return {c.name for c in self.calls}
+
+
+class ResourceModel:
+    """The cross-file lifecycle surface, built once per analyzer run by
+    whichever v5 checker's collect pass sees a file first."""
+
+    def __init__(self) -> None:
+        self.kinds: dict[str, KindDecl] = {}
+        # device-affine method -> "Class (path)" declaration site
+        self.device_methods: dict[str, str] = {}
+        self.device_decl_paths: set[str] = set()  # files that declared them
+        # (path, class) -> declared loop-root method names
+        self.loop_roots: dict[tuple[str, str], tuple[str, ...]] = {}
+        self.functions: list[FuncInfo] = []
+        # path -> {class -> set of method names} (proxy-class rule)
+        self.class_methods: dict[str, dict[str, set[str]]] = {}
+        self.files: dict[str, SourceFile] = {}
+        self._seen: set[str] = set()
+
+    # -- convenience views ---------------------------------------------------
+
+    def functions_named(self, name: str) -> list[FuncInfo]:
+        return [f for f in self.functions if f.name == name]
+
+    def transitive_releasers(self, kind: str) -> set[str]:
+        """Function NAMES that release ``kind`` directly or through any
+        chain of same-package wrappers (``_paged_release`` ->
+        ``paged_finish`` -> pool ``finish``), to a fixpoint."""
+        decl = self.kinds.get(kind)
+        if decl is None:
+            return set()
+        releasers = set(decl.releases)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if fn.name in releasers:
+                    continue
+                if fn.call_names() & releasers:
+                    releasers.add(fn.name)
+                    changed = True
+        return releasers
+
+    def loop_closure(self, path: str, cls: str) -> set[str]:
+        """Same-class methods reachable from the declared loop roots via
+        ``self.X()`` calls, to a fixpoint — the batching-loop thread's
+        call closure, inside which device-affine calls are legal."""
+        roots = self.loop_roots.get((path, cls))
+        if not roots:
+            return set()
+        by_name = {
+            f.name: f
+            for f in self.functions
+            if f.path == path and f.cls == cls
+        }
+        closure = {r for r in roots if r in by_name}
+        frontier = list(closure)
+        while frontier:
+            fn = by_name[frontier.pop()]
+            for callee in fn.self_calls:
+                if callee in by_name and callee not in closure:
+                    closure.add(callee)
+                    frontier.append(callee)
+        return closure
+
+
+# -- extraction ---------------------------------------------------------------
+
+
+def _parse_kind_decl(stmt: ast.Assign) -> dict[str, tuple[str, ...]]:
+    decl = ast.literal_eval(stmt.value)
+    if not isinstance(decl, dict):
+        raise ValueError("declaration must be a dict literal")
+    out: dict[str, tuple[str, ...]] = {}
+    for kind, methods in decl.items():
+        if not isinstance(kind, str) or not kind:
+            raise ValueError("kind names must be non-empty strings")
+        methods_t = (methods,) if isinstance(methods, str) else tuple(methods)
+        if not methods_t or not all(isinstance(m, str) for m in methods_t):
+            raise ValueError("method names must be strings")
+        out[kind] = methods_t
+    return out
+
+
+def _parse_name_tuple(stmt: ast.Assign) -> tuple[str, ...]:
+    decl = ast.literal_eval(stmt.value)
+    names = (decl,) if isinstance(decl, str) else tuple(decl)
+    if not names or not all(isinstance(n, str) for n in names):
+        raise ValueError("expected a tuple of method-name strings")
+    return names
+
+
+def _class_decls(model: ResourceModel, sf: SourceFile, project: Project,
+                 node: ast.ClassDef, methods: set[str]) -> None:
+    site = f"{node.name} ({sf.display})"
+    for stmt in node.body:
+        if not (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+        ):
+            continue
+        target = stmt.targets[0].id
+        if target not in (
+            ACQUIRE_DECL_NAME, RELEASE_DECL_NAME,
+            DEVICE_DECL_NAME, LOOP_DECL_NAME,
+        ):
+            continue
+        check = (
+            "device-affinity"
+            if target in (DEVICE_DECL_NAME, LOOP_DECL_NAME)
+            else "resource-balance"
+        )
+        try:
+            if target in (ACQUIRE_DECL_NAME, RELEASE_DECL_NAME):
+                by_kind = _parse_kind_decl(stmt)
+            else:
+                names = _parse_name_tuple(stmt)
+        except (ValueError, TypeError, SyntaxError) as e:
+            project.collect_findings.append(Finding(
+                check, sf.display, stmt.lineno,
+                f"malformed {target} on class {node.name}: {e}",
+            ))
+            continue
+        if target in (ACQUIRE_DECL_NAME, RELEASE_DECL_NAME):
+            for kind, names in by_kind.items():
+                decl = model.kinds.setdefault(kind, KindDecl(kind))
+                bucket = (
+                    decl.acquires
+                    if target == ACQUIRE_DECL_NAME
+                    else decl.releases
+                )
+                for name in names:
+                    if name not in methods:
+                        # rot-guard: a declaration naming a method the
+                        # class does not define is stale the moment it
+                        # is written
+                        project.collect_findings.append(Finding(
+                            check, sf.display, stmt.lineno,
+                            f"{target} on class {node.name} names "
+                            f"{name!r}, which {node.name} does not "
+                            "define",
+                        ))
+                        continue
+                    bucket[name] = site
+        elif target == DEVICE_DECL_NAME:
+            for name in names:
+                if name not in methods:
+                    project.collect_findings.append(Finding(
+                        check, sf.display, stmt.lineno,
+                        f"{target} on class {node.name} names {name!r}, "
+                        f"which {node.name} does not define",
+                    ))
+                    continue
+                model.device_methods[name] = site
+                model.device_decl_paths.add(sf.display)
+        else:  # LOOP_DECL_NAME
+            missing = [n for n in names if n not in methods]
+            for name in missing:
+                project.collect_findings.append(Finding(
+                    check, sf.display, stmt.lineno,
+                    f"{target} on class {node.name} names {name!r}, "
+                    f"which {node.name} does not define",
+                ))
+            kept = tuple(n for n in names if n in methods)
+            if kept:
+                model.loop_roots[(sf.display, node.name)] = kept
+
+
+def _funnel_names(fn_node: ast.AST) -> set[str]:
+    """Names that alias run_device_op inside one function: the funnel
+    itself, plus locals assigned from ``X.run_device_op`` or
+    ``getattr(X, "run_device_op", ...)`` (the duck-typed dispatch the
+    HTTP layer uses)."""
+    names = {DEVICE_FUNNEL}
+    for node in ast.walk(fn_node):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            continue
+        v = node.value
+        aliased = (
+            isinstance(v, ast.Attribute) and v.attr == DEVICE_FUNNEL
+        ) or (
+            isinstance(v, ast.Call)
+            and last_component(v.func) == "getattr"
+            and any(
+                isinstance(a, ast.Constant) and a.value == DEVICE_FUNNEL
+                for a in v.args
+            )
+        )
+        if aliased:
+            names.add(node.targets[0].id)
+    return names
+
+
+def _extract_functions(model: ResourceModel, sf: SourceFile) -> None:
+    """One pass with ancestor context: every def becomes a FuncInfo whose
+    calls/raises carry the try/funnel context the checks consume."""
+    stack: list[FuncInfo] = []
+    class_stack: list[str] = []
+    # per-FuncInfo funnel-alias set, computed lazily on entry
+    funnels: list[set[str]] = []
+
+    def rec(node: ast.AST, anc: list[ast.AST]) -> None:
+        is_def = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if isinstance(node, ast.ClassDef):
+            class_stack.append(node.name)
+        if is_def:
+            cls = class_stack[-1] if class_stack else None
+            # nested defs qualify under their own name only — calls in a
+            # nested def still attribute to it, not the outer function
+            qual = f"{cls}.{node.name}" if cls else node.name
+            info = FuncInfo(
+                path=sf.display, name=node.name, qual=qual,
+                line=node.lineno, cls=cls, node=node,
+            )
+            model.functions.append(info)
+            stack.append(info)
+            funnels.append(_funnel_names(node))
+        elif stack:
+            info = stack[-1]
+            if isinstance(node, ast.Call):
+                name = last_component(node.func)
+                if name is not None:
+                    in_funnel = _inside_funnel_arg(anc, funnels[-1])
+                    _, body_trys = _try_context(anc, node)
+                    info.calls.append(CallSite(
+                        name, node.lineno, in_funnel, body_trys,
+                    ))
+                    if (
+                        isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                    ):
+                        info.self_calls.add(name)
+            elif isinstance(node, ast.Raise):
+                handler_try, body_trys = _try_context(anc, node)
+                info.raises.append(RaiseSite(
+                    node.lineno, handler_try, body_trys,
+                ))
+        anc.append(node)
+        for c in ast.iter_child_nodes(node):
+            rec(c, anc)
+        anc.pop()
+        if is_def:
+            stack.pop()
+            funnels.pop()
+        if isinstance(node, ast.ClassDef):
+            class_stack.pop()
+
+    rec(sf.tree, [])
+
+
+def _try_context(
+    anc: list[ast.AST], node: ast.AST
+) -> tuple[ast.Try | None, tuple[ast.Try, ...]]:
+    """(try whose HANDLER contains node, trys whose BODY contains node)
+    — scanning outward to the function boundary. Python semantics drive
+    the split: only body-trys' handlers will catch an exception leaving
+    ``node``; a handler's own try will not."""
+    handler_try: ast.Try | None = None
+    body_trys: list[ast.Try] = []
+    child: ast.AST = node
+    for a in reversed(anc):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            break
+        if isinstance(a, ast.ExceptHandler) and handler_try is None:
+            for outer in anc:
+                if isinstance(outer, ast.Try) and a in outer.handlers:
+                    handler_try = outer
+                    break
+        elif isinstance(a, ast.Try) and any(child is s for s in a.body):
+            body_trys.append(a)
+        child = a
+    return handler_try, tuple(body_trys)
+
+
+def _inside_funnel_arg(anc: list[ast.AST], funnel_names: set[str]) -> bool:
+    """True when some ancestor closure (lambda or nested def name) is an
+    argument to a run_device_op(-aliased) call."""
+    for i, a in enumerate(anc):
+        if isinstance(a, ast.Lambda):
+            parent = anc[i - 1] if i else None
+            if (
+                isinstance(parent, ast.Call)
+                and a in parent.args
+                and last_component(parent.func) in funnel_names
+            ):
+                return True
+    return False
+
+
+def ingest_file(model: ResourceModel, sf: SourceFile,
+                project: Project) -> None:
+    """Idempotent per-file extraction — both v5 checkers call this from
+    collect; the first call per file does the work."""
+    if sf.display in model._seen:
+        return
+    model._seen.add(sf.display)
+    model.files[sf.display] = sf
+    per_class = model.class_methods.setdefault(sf.display, {})
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            methods = {
+                s.name
+                for s in node.body
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            per_class[node.name] = methods
+            _class_decls(model, sf, project, node, methods)
+    _extract_functions(model, sf)
+
+
+def project_model(project: Project) -> ResourceModel:
+    model = getattr(project, "resource_model", None)
+    if model is None:
+        model = ResourceModel()
+        project.resource_model = model
+    return model
+
+
+# -- reviewer surfaces --------------------------------------------------------
+
+
+def build_model(paths) -> ResourceModel:
+    """Standalone model over ``paths`` (the CLI table / DOT dump and the
+    rot-guard tests — no analyzer run needed)."""
+    from .core import iter_py_files, parse_waivers
+
+    model = ResourceModel()
+    project = Project()
+    project.resource_model = model
+    for p in iter_py_files(paths):
+        try:
+            text = p.read_text(encoding="utf-8")
+            tree = ast.parse(text, filename=str(p))
+        except (OSError, SyntaxError, ValueError):
+            continue
+        sf = SourceFile(path=p, display=p.name, text=text, tree=tree)
+        sf.waivers, _ = parse_waivers(text, {"resource-balance",
+                                             "device-affinity"}, sf.display)
+        ingest_file(model, sf, project)
+    return model
+
+
+def resource_dot(model: ResourceModel) -> str:
+    """DOT resource-flow graph: acquire -> kind -> release edges per
+    declared vocabulary; waived transfers (functions carrying an
+    ``ok[resource-balance]`` waiver) attach dashed."""
+    lines = [
+        "digraph resources {",
+        '  rankdir=LR; node [shape=box, fontsize=10];',
+    ]
+    for kind in sorted(model.kinds):
+        decl = model.kinds[kind]
+        knode = f'"[{kind}]"'
+        lines.append(f'  {knode} [shape=ellipse, style=bold];')
+        for name in sorted(decl.acquires):
+            lines.append(f'  "{name}" -> {knode} [label="acquire"];')
+        for name in sorted(decl.releases):
+            lines.append(f'  {knode} -> "{name}" [label="release"];')
+    # dashed edges: intentional transfers waived in-source
+    for sf in model.files.values():
+        for line_no, waiver in sorted(sf.waivers.items()):
+            if not waiver.covers("resource-balance"):
+                continue
+            # attribute the waiver to the last function starting at or
+            # before its line (lexical owner)
+            owner = None
+            for fn in model.functions:
+                if fn.path != sf.display or fn.line > line_no:
+                    continue
+                if owner is None or fn.line > owner.line:
+                    owner = fn
+            label = waiver.reason.replace('"', "'")[:40]
+            src = f'"{owner.qual}"' if owner else f'"{sf.display}:{line_no}"'
+            lines.append(
+                f'  {src} -> "transfer" [style=dashed, label="{label}"];'
+            )
+    lines.append("}")
+    return "\n".join(lines)

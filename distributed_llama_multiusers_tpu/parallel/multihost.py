@@ -1181,6 +1181,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
             # (the root restores its own via stats.preserved())
             engine.stats.reset()
         elif op == OP_COPY_LANE:
+            # dlint: ok[device-affinity] the worker replay loop IS this process's batching thread — every device call replays here in root order
             engine.copy_lane(lane, start_pos)  # src, dst ride the header
         elif op == OP_KV_TABLE:
             # paged KV table update: row length rides n, COW pair count
@@ -1194,6 +1195,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                     "--paged-kv flags are skewed"
                 )
             if lane < 0:
+                # dlint: ok[device-affinity] worker replay loop = this process's batching thread
                 engine.paged_unmap_all()
             else:
                 if n != engine.kvpool.blocks_per_lane:
@@ -1209,6 +1211,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                         "geometry flags are skewed"
                     )
                 pairs = plane.slot(pkt, 1, 2 * start_pos)
+                # dlint: ok[device-affinity] worker replay loop = this process's batching thread
                 engine.apply_paged_admit(
                     lane,
                     plane.slot(pkt, 0, n).copy(),
@@ -1235,6 +1238,7 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                 blob = bytes(page_buf)
                 page_buf = bytearray()
                 try:
+                    # dlint: ok[device-affinity] worker replay loop = this process's batching thread
                     engine.import_kv_page(start_pos, blob)
                 except ValueError as e:
                     # geometry skew (root and worker disagree on the

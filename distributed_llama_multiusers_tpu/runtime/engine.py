@@ -237,6 +237,26 @@ class EngineStats:
 
 
 class InferenceEngine:
+    # dlint resource-lifecycle declaration (analysis/resourcemodel.py):
+    # the engine's paged façade mirrors the pool's lane-page ownership —
+    # ``paged_admit`` acquires (pool admit + device table write as one
+    # unit), ``paged_finish``/``paged_reset`` give it back. Same kind as
+    # the pool's own vocabulary so wrappers of either balance.
+    _dlint_acquires = {"kv-page": ("paged_admit",)}
+    _dlint_releases = {"kv-page": ("paged_finish", "paged_reset")}
+
+    # dlint device-affinity declaration: these methods touch pytrees the
+    # compiled step families DONATE (engine.cache, the paged table, the
+    # grammar slab). Off the batching loop they race the live chain —
+    # the step that is about to consume the buffer they mutate (the race
+    # PR 16 caught live). Legal callers: the loop-thread closure
+    # (_dlint_loop_roots on the scheduler) or a closure handed to
+    # scheduler.run_device_op(). Checked by dlint device-affinity.
+    _dlint_device_affine = (
+        "apply_paged_admit", "copy_lane", "paged_unmap_all",
+        "export_kv_page", "import_kv_page",
+    )
+
     def __init__(
         self,
         config: LlamaConfig,

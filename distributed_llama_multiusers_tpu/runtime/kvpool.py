@@ -125,6 +125,15 @@ class KVPagePool:
         ),
     }
 
+    # dlint resource-lifecycle declaration (analysis/resourcemodel.py):
+    # lane page ownership. ``admit``/``adopt`` hand lane-held pages to
+    # the caller; every exit path must reach ``finish`` (park or free),
+    # ``release``/``drop_parked`` (park holds), or ``reset``. Checked by
+    # resource-balance; witnessed at runtime via ``pool_pages_in_use``
+    # (analysis/leakcheck.py, DLLAMA_LEAKCHECK=1).
+    _dlint_acquires = {"kv-page": ("admit", "adopt")}
+    _dlint_releases = {"kv-page": ("finish", "release", "drop_parked", "reset")}
+
     def __init__(
         self,
         n_pages: int,
@@ -681,6 +690,12 @@ class KVPagePool:
             return {
                 "pool_pages_total": self.n_pages,
                 "pool_pages_free": len(self._free),
+                # distinct pages some LANE currently holds (parked pages
+                # excluded): the leak witness's kv-page gauge — a drained
+                # scheduler must read 0 here (analysis/leakcheck.py)
+                "pool_pages_in_use": len(
+                    {p for blocks in self._lane_blocks for p in blocks}
+                ),
                 "pool_page_size": self.page_size,
                 "pool_parked_sessions": len(self._parked),
                 "pool_parked_pages": self._parked_pages,

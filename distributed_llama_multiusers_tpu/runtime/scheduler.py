@@ -49,7 +49,7 @@ from ..serving import (
     drain_scheduler,
     queue_expired,
 )
-from ..analysis import jitcheck
+from ..analysis import jitcheck, leakcheck
 from ..lockcheck import make_lock
 from ..serving.watchdog import deadline_from_env
 from ..telemetry import Telemetry
@@ -288,6 +288,22 @@ class ContinuousBatchingScheduler:
     _dlint_guarded_by = {
         ("_device_ops_lock",): ("_device_ops",),
     }
+
+    # dlint resource-lifecycle declaration (analysis/resourcemodel.py):
+    # the live-session mirror. ``_mirror_admit`` (in _start_request)
+    # publishes the migration ticket; every request that reached a lane
+    # must pass ``_mirror_finish`` (_finish or _fail_request) or the
+    # mirror grows one dead ticket per request. Checked by
+    # resource-balance; counted at stop() by the leak witness
+    # (analysis/leakcheck.py, DLLAMA_LEAKCHECK=1).
+    _dlint_acquires = {"session-record": ("_mirror_admit",)}
+    _dlint_releases = {"session-record": ("_mirror_finish",)}
+
+    # dlint device-affinity declaration: the batching-loop closure grows
+    # from here by same-class ``self.X()`` calls — methods in it may
+    # call the engine's ``_dlint_device_affine`` surface directly; every
+    # other thread goes through run_device_op().
+    _dlint_loop_roots = ("_run",)
 
     def __init__(
         self,
@@ -553,6 +569,32 @@ class ContinuousBatchingScheduler:
             # barrier, not close: the journal outlives scheduler restarts
             # (its creator — runtime_setup / the test — owns closing it)
             self.journal.flush()
+        # resource-leak witness (analysis/leakcheck.py): the loop joined
+        # and _resolve_exit settled every lane, so every count below is
+        # zero on a clean stop — anything held is an acquire whose
+        # release lost an exit path. Counted always; raises under
+        # DLLAMA_LEAKCHECK=1.
+        leakcheck.check_drained("scheduler stop", self.leak_counts())
+
+    def leak_counts(self) -> dict[str, int]:
+        """Authoritative live counts for every resource kind this
+        scheduler owns (the declared _dlint_acquires surfaces): lane-held
+        KV pages, session-mirror tickets, open journal marks, pending
+        device ops. The leak witness's drain snapshot — also surfaced on
+        /stats as ``resources_live`` between drains."""
+        counts = {"session_records": len(self._session_records)}
+        with self._device_ops_lock:
+            counts["device_ops"] = len(self._device_ops)
+        pool_stats = getattr(self.engine, "pool_stats", None)
+        if callable(pool_stats):
+            counts["kv_lane_pages"] = int(
+                (pool_stats() or {}).get("pool_pages_in_use", 0)
+            )
+        if self.journal is not None:
+            counts["journal_marks"] = int(
+                self.journal.stats().get("journal_open_marks", 0)
+            )
+        return counts
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful shutdown (serving/drain.py): stop admitting — submit()
@@ -876,6 +918,19 @@ class ContinuousBatchingScheduler:
         if not req.future.done():
             req.future.set_exception(AdmissionRejected("draining", retry_after_s=5.0))
 
+    def _mirror_admit(self, req: Request, admit_kw: dict) -> None:
+        """Publish the live-session mirror entry (the fleet migration
+        ticket). Loop thread only; entries are built whole and assigned
+        with a single-key dict op (GIL-atomic) so export_session can
+        read whole tickets from HTTP threads. The declared acquire half
+        of the session-record lifecycle (_dlint_acquires)."""
+        self._session_records[req.id] = (admit_record(**admit_kw), req)
+
+    def _mirror_finish(self, req: Request) -> None:
+        """Retire the mirror entry — the declared release half; idempotent
+        (a drain force-cancel may race a normal finish)."""
+        self._session_records.pop(req.id, None)
+
     def _fail_request(self, lane_idx: int, req: Request, error: str,
                       exc: BaseException | None = None) -> None:
         """Fail ONE request with ``finish_reason="error"`` and reclaim its
@@ -890,7 +945,7 @@ class ContinuousBatchingScheduler:
         req.error = error
         req.finish_reason = "error"
         # failed contents are final: the session can no longer migrate
-        self._session_records.pop(req.id, None)
+        self._mirror_finish(req)
         self._grammar_release(self._lanes[lane_idx])
         self._lanes[lane_idx] = _Lane()
         self._lane_kv[lane_idx] = []
@@ -1133,7 +1188,7 @@ class ContinuousBatchingScheduler:
             stream=req.on_delta is not None, kind=req.api_kind,
             response_format=req.response_format,
         )
-        self._session_records[req.id] = (admit_record(**admit_kw), req)
+        self._mirror_admit(req, admit_kw)
         if self.journal is not None:
             # the call only enqueues — the journal's writer thread does
             # the file I/O off this loop
@@ -1860,7 +1915,7 @@ class ContinuousBatchingScheduler:
         # the migration ticket dies with the session: a finished request
         # has nothing left to move (routers fetch their ticket at stream
         # start, so a drain/stop force-cancel popping this is fine)
-        self._session_records.pop(req.id, None)
+        self._mirror_finish(req)
         delta = self._lanes[lane_idx].eos.get_delta()
         if delta:
             req.generated_text += delta

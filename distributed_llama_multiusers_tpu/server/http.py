@@ -42,6 +42,7 @@ import json
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..analysis import leakcheck
 from ..runtime.scheduler import Request, fresh_request_id
 from ..serving import (
     AdmissionRejected,
@@ -410,6 +411,17 @@ class ApiServer:
             "lanes_total": total,
             "lanes_busy": busy,
         }
+        # resource lifecycles (analysis/leakcheck.py): the process-wide
+        # witness counters — resources found held at drain points (MUST
+        # read 0, the leak twin of jit_compiles_after_warmup) — plus
+        # this scheduler's LIVE ownership gauge (busy serving holds
+        # pages/tickets/marks legitimately; only drain points assert
+        # zero). bridge_stats republishes resources_live as a labelled
+        # gauge and delta-feeds dllama_resource_leaks_total (telemetry/hub)
+        out.update(leakcheck.stats())
+        leak_counts = getattr(sched, "leak_counts", None)
+        if callable(leak_counts):
+            out["resources_live"] = leak_counts()
         qos = getattr(sched, "qos_stats", None)
         if callable(qos):  # queue depth/wait/rejections, timeouts, drain
             out.update(qos())
@@ -727,6 +739,7 @@ class ApiServer:
                     if callable(run):
                         receipt = run(lambda: adopt_bundle(pool, engine, body))
                     else:
+                        # dlint: ok[device-affinity] scheduler stand-ins without run_device_op have no loop thread racing the adopt
                         receipt = adopt_bundle(pool, engine, body)
                 except KVTransferError as e:
                     # 422: the bundle itself is bad (corrupt, wrong

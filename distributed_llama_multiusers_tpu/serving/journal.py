@@ -301,6 +301,16 @@ class RequestJournal:
         ),
     }
 
+    # dlint resource-lifecycle declaration (analysis/resourcemodel.py):
+    # ``record_admit`` opens a per-request progress mark that only
+    # ``record_finish`` closes — an admit whose finish record lost an
+    # exit path grows ``_j_progress_mark`` forever (the PR 10 leak this
+    # mark map originally shipped with). Checked by resource-balance;
+    # witnessed via ``journal_open_marks`` at scheduler stop
+    # (analysis/leakcheck.py).
+    _dlint_acquires = {"journal-mark": ("record_admit",)}
+    _dlint_releases = {"journal-mark": ("record_finish",)}
+
     def __init__(self, path: str, progress_every: int = 8,
                  fsync: bool = True):
         if progress_every < 1:
@@ -500,4 +510,10 @@ class RequestJournal:
                 "journal_errors": self._j_errors,
                 "journal_dropped": self._j_dropped,
                 "journal_pending": len(self._j_pending),
+                # admits whose finish record has not landed yet: the
+                # leak witness's journal-mark gauge — after a clean
+                # scheduler stop every admitted request finished, so a
+                # non-zero count is a record_admit whose record_finish
+                # lost an exit path (analysis/leakcheck.py)
+                "journal_open_marks": len(self._j_progress_mark),
             }

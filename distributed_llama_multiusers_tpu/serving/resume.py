@@ -42,6 +42,7 @@ import bisect
 import threading
 import time
 
+from ..analysis import leakcheck
 from ..lockcheck import make_lock
 
 DEFAULT_RELAY_CAPACITY = 4096
@@ -224,6 +225,15 @@ class StreamRegistry:
         ),
     }
 
+    # dlint resource-lifecycle declaration (analysis/resourcemodel.py):
+    # ``register`` indexes an entry only the request's RESOLVED future
+    # (reaper done-rule) or an explicit ``discard`` can remove — a shed
+    # between register and submit with no discard leaks the entry
+    # forever, the exact PR 10 bug class. Checked by resource-balance;
+    # orphans witnessed at close() (analysis/leakcheck.py).
+    _dlint_acquires = {"stream-entry": ("register",)}
+    _dlint_releases = {"stream-entry": ("discard", "close")}
+
     def __init__(self, grace_s: float, relay_capacity: int = DEFAULT_RELAY_CAPACITY):
         if grace_s <= 0:
             raise ValueError("StreamRegistry needs a positive grace window")
@@ -339,7 +349,22 @@ class StreamRegistry:
                 req.cancel()
 
     def close(self, timeout: float | None = 5.0) -> None:
+        # resource-leak witness (analysis/leakcheck.py): close runs after
+        # the scheduler stopped, and a stopped scheduler resolved every
+        # future it ever saw — an entry whose future is still pending
+        # belongs to a request that NEVER entered service and was never
+        # discarded (the PR 10 shed-path leak class); no reaper rule can
+        # ever collect it. Live attached/finished streams all have done
+        # futures by now and are NOT orphans.
         with self._cv:
+            orphans = sum(
+                1
+                for e in self._rg_entries.values()
+                if not e.req.future.done()
+            )
             self._rg_closed = True
             self._cv.notify_all()
         self._thread.join(timeout)
+        leakcheck.check_drained(
+            "stream registry close", {"stream_entries": orphans}
+        )

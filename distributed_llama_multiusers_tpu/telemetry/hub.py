@@ -155,8 +155,21 @@ class Telemetry:
             "recompile witness (the /stats jit_compiles_after_warmup "
             "field, delta-fed) — non-zero means a mid-serving recompile",
         )
+        # resource lifecycle (analysis/leakcheck.py): resources found
+        # still held at a drain point (scheduler stop, registry close) as
+        # a native counter beside the dllama_stats_resource_leaks_total
+        # gauge the bridge republishes — delta-fed with the sync-bytes
+        # recipe; MUST stay flat (a rise means an acquire escaped every
+        # release path, the runtime twin of the resource-balance lint)
+        self.resource_leaks = reg.counter(
+            "dllama_resource_leaks_total",
+            "resources still held at a drain point — scheduler stop or "
+            "stream-registry close (the /stats resource_leaks_total "
+            "field, delta-fed); non-zero means a lifecycle leak",
+        )
         self._sync_bytes_seen = 0
         self._jit_compiles_seen = 0.0
+        self._resource_leaks_seen = 0.0
         self._spec_emitted_seen = 0.0
         self._journal_records_seen = 0.0
         self._recovered_seen = 0.0
@@ -447,6 +460,11 @@ class Telemetry:
             # monotone delta-feed recipe applies verbatim
             ("jit_compiles_after_warmup", self.jit_compiles,
              "_jit_compiles_seen"),
+            # resource_leaks_total never resets within a process either
+            # (leakcheck.force(fresh=True) is test-only), so the same
+            # monotone recipe applies
+            ("resource_leaks_total", self.resource_leaks,
+             "_resource_leaks_seen"),
         ):
             v = stats.get(fld)
             if isinstance(v, (int, float)) and not isinstance(v, bool):

@@ -1,0 +1,499 @@
+"""dlint v5: the resource-lifecycle surface model and its two checks
+(resource-balance, device-affinity) — fixture-tested as programs, plus
+rot-guards binding the model to the REAL declarations in the tree.
+
+Layers, per the test_dlint.py contract:
+
+- **known-bad / known-good fixtures** per excuse and legality rule, so
+  each rule is regression-tested rather than trusted on the current
+  tree's verdict;
+- **real-declaration rot-guards** — the shipped ``_dlint_acquires`` /
+  ``_dlint_releases`` / ``_dlint_device_affine`` / ``_dlint_loop_roots``
+  declarations must keep reaching the model (a renamed method would
+  otherwise silently hollow the checks out);
+- **reporting plumbing** — finalize findings survive ``--changed``
+  scoping, and the new rule ids reach the SARIF/list surfaces.
+
+Pure-stdlib imports: these tests run without jax.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from distributed_llama_multiusers_tpu.analysis import (
+    Analyzer,
+    default_checkers,
+)
+from distributed_llama_multiusers_tpu.analysis.cli import main as dlint_main
+from distributed_llama_multiusers_tpu.analysis.resourcemodel import (
+    build_model,
+    resource_dot,
+)
+
+PACKAGE = Path(__file__).resolve().parent.parent / (
+    "distributed_llama_multiusers_tpu"
+)
+
+# the real files carrying lifecycle declarations (rot-guard scope)
+DECL_FILES = [
+    PACKAGE / "runtime" / "kvpool.py",
+    PACKAGE / "runtime" / "engine.py",
+    PACKAGE / "runtime" / "scheduler.py",
+    PACKAGE / "serving" / "resume.py",
+    PACKAGE / "serving" / "journal.py",
+]
+
+
+def run_on(tmp_path: Path, files: dict[str, str], check_only=None):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    analyzer = Analyzer(default_checkers())
+    return analyzer.run(
+        [tmp_path], baseline=set(), root=tmp_path, check_only=check_only
+    )
+
+
+def of(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+# a minimal declared kind shared by the resource-balance fixtures
+POOL = """
+    class Pool:
+        _dlint_acquires = {"widget": ("grab",)}
+        _dlint_releases = {"widget": ("put_back",)}
+
+        def grab(self):
+            return object()
+
+        def put_back(self, h):
+            pass
+"""
+
+
+# -- resource-balance: known bad ---------------------------------------------
+
+
+def test_raise_after_acquire_fires(tmp_path):
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        def leaky(pool, n):
+            h = pool.grab()
+            if n > 3:
+                raise ValueError("shed")
+            return h
+    """}), "resource-balance")
+    assert len(findings) == 1
+    f = findings[0]
+    assert "leaky" in f.message and "widget" in f.message
+    assert "grab()" in f.message
+    assert f.path.endswith("use.py")
+
+
+def test_raise_in_later_except_arm_fires(tmp_path):
+    """A raise inside the handler of a try AFTER the acquire is not the
+    acquire-may-have-failed shape — the widget is held."""
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        def leaky(pool):
+            h = pool.grab()
+            try:
+                step()
+            except RuntimeError:
+                raise ValueError("held!")
+            return h
+
+        def step():
+            pass
+    """}), "resource-balance")
+    assert len(findings) == 1
+
+
+def test_half_declared_kind_fires(tmp_path):
+    findings = of(run_on(tmp_path, {"pool.py": """
+        class Pool:
+            _dlint_acquires = {"widget": ("grab",)}
+
+            def grab(self):
+                return object()
+    """}), "resource-balance")
+    assert any("no release" in f.message for f in findings)
+
+
+def test_declared_method_must_exist(tmp_path):
+    """Rot-guard: declaring a method the class no longer defines is a
+    finding (the declaration would silently stop covering anything)."""
+    findings = of(run_on(tmp_path, {"pool.py": """
+        class Pool:
+            _dlint_acquires = {"widget": ("grab_renamed",)}
+            _dlint_releases = {"widget": ("put_back",)}
+
+            def grab(self):
+                return object()
+
+            def put_back(self, h):
+                pass
+    """}), "resource-balance")
+    assert any("grab_renamed" in f.message for f in findings)
+
+
+# -- resource-balance: the excuse rules (known good) -------------------------
+
+
+def test_raise_in_acquires_own_except_arm_ok(tmp_path):
+    """Excuse 1: the acquire itself may be what failed — nothing held."""
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        def careful(pool):
+            try:
+                h = pool.grab()
+            except MemoryError:
+                raise ValueError("pool exhausted")
+            return h
+    """}), "resource-balance")
+    assert findings == []
+
+
+def test_release_between_acquire_and_raise_ok(tmp_path):
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        def careful(pool, n):
+            h = pool.grab()
+            if n > 3:
+                pool.put_back(h)
+                raise ValueError("shed")
+            return h
+    """}), "resource-balance")
+    assert findings == []
+
+
+def test_releasing_handler_catches_raise_ok(tmp_path):
+    """Excuse 3: cleanup-at-catch — an enclosing try's handler releases,
+    through a transitive wrapper."""
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        def _cleanup(pool, h):
+            pool.put_back(h)
+
+        def careful(pool, n):
+            h = pool.grab()
+            try:
+                if n > 3:
+                    raise ValueError("shed")
+            except ValueError:
+                _cleanup(pool, h)
+                raise
+            return h
+    """}), "resource-balance")
+    assert findings == []
+
+
+def test_every_call_site_releasing_ok(tmp_path):
+    """Excuse 4 (interprocedural): the owner one frame up releases on
+    failure at EVERY call site."""
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        def claim(pool, n):
+            h = pool.grab()
+            if n > 3:
+                raise ValueError("shed mid-claim")
+            return h
+
+        def owner(pool, n):
+            try:
+                return claim(pool, n)
+            except ValueError:
+                pool.put_back(None)
+                raise
+    """}), "resource-balance")
+    assert findings == []
+
+
+def test_unprotected_call_site_still_fires(tmp_path):
+    """Excuse 4's ALL-sites rule: one bare call site keeps the finding."""
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        def claim(pool, n):
+            h = pool.grab()
+            if n > 3:
+                raise ValueError("shed mid-claim")
+            return h
+
+        def owner(pool, n):
+            try:
+                return claim(pool, n)
+            except ValueError:
+                pool.put_back(None)
+                raise
+
+        def bare(pool):
+            return claim(pool, 9)
+    """}), "resource-balance")
+    assert len(findings) == 1
+
+
+def test_waived_transfer_ok(tmp_path):
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        class Parked(Exception):
+            pass
+
+        def park(pool):
+            h = pool.grab()
+            # dlint: ok[resource-balance] ticket transfer to the parker
+            raise Parked(h)
+    """}), "resource-balance")
+    assert findings == []
+
+
+def test_return_is_ownership_transfer(tmp_path):
+    """A plain return is never flagged — returning the acquired resource
+    IS the normal API shape."""
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        def handout(pool):
+            return pool.grab()
+    """}), "resource-balance")
+    assert findings == []
+
+
+def test_vocabulary_functions_exempt(tmp_path):
+    """Proxy/mock implementations NAMED like the vocabulary (a facade's
+    own grab()) are implementations, not consumers."""
+    findings = of(run_on(tmp_path, {"pool.py": POOL, "use.py": """
+        class Facade:
+            def grab(self):
+                h = self.pool.grab()
+                if h is None:
+                    raise MemoryError("exhausted")
+                return h
+    """}), "resource-balance")
+    assert findings == []
+
+
+# -- device-affinity ----------------------------------------------------------
+
+ENGINE = """
+    class Engine:
+        _dlint_device_affine = ("touch_cache",)
+
+        def touch_cache(self):
+            pass
+
+        def helper(self):
+            self.touch_cache()  # legal: declaring file
+"""
+
+SCHED = """
+    class Sched:
+        _dlint_loop_roots = ("_run",)
+
+        def __init__(self, engine):
+            self.engine = engine
+
+        def _run(self):
+            self._step()
+
+        def _step(self):
+            self.engine.touch_cache()  # legal: loop closure
+
+        def run_device_op(self, fn):
+            return fn()
+"""
+
+
+def test_off_loop_device_touch_fires(tmp_path):
+    findings = of(run_on(tmp_path, {
+        "engine.py": ENGINE, "sched.py": SCHED, "admin.py": """
+        def admin_touch(engine):
+            engine.touch_cache()
+    """}), "device-affinity")
+    assert len(findings) == 1
+    f = findings[0]
+    assert "touch_cache" in f.message and "admin_touch" in f.message
+    assert f.path.endswith("admin.py")
+
+
+def test_loop_closure_and_decl_file_ok(tmp_path):
+    findings = of(run_on(tmp_path, {
+        "engine.py": ENGINE, "sched.py": SCHED,
+    }), "device-affinity")
+    assert findings == []
+
+
+def test_run_device_op_lambda_ok(tmp_path):
+    findings = of(run_on(tmp_path, {
+        "engine.py": ENGINE, "sched.py": SCHED, "admin.py": """
+        def admin_ok(sched, engine):
+            return sched.run_device_op(lambda: engine.touch_cache())
+    """}), "device-affinity")
+    assert findings == []
+
+
+def test_funnel_alias_ok(tmp_path):
+    """A local alias of run_device_op (including the getattr probe the
+    HTTP layer uses) still counts as the funnel."""
+    findings = of(run_on(tmp_path, {
+        "engine.py": ENGINE, "sched.py": SCHED, "admin.py": """
+        def admin_ok(sched, engine):
+            run = getattr(sched, "run_device_op", None)
+            if run is None:
+                return None
+            return run(lambda: engine.touch_cache())
+    """}), "device-affinity")
+    assert findings == []
+
+
+def test_facade_class_ok(tmp_path):
+    """A class defining a declared device-affine name is part of the
+    engine surface (RootControlEngine) — its method bodies inherit the
+    affinity contract even when calling a DIFFERENT primitive."""
+    findings = of(run_on(tmp_path, {
+        "engine.py": ENGINE, "sched.py": SCHED, "proxy.py": """
+        class Proxy:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def touch_cache(self):
+                self.inner.touch_cache()
+
+            def reset(self):
+                self.inner.touch_cache()
+    """}), "device-affinity")
+    assert findings == []
+
+
+def test_caller_legality_fixpoint_ok(tmp_path):
+    """A helper whose EVERY call site is legal (a funnel lambda)
+    inherits legality — the disagg export/import helper shape."""
+    findings = of(run_on(tmp_path, {
+        "engine.py": ENGINE, "sched.py": SCHED, "helpers.py": """
+        def export_pages(engine):
+            engine.touch_cache()
+            return []
+
+        def endpoint(sched, engine):
+            return sched.run_device_op(lambda: export_pages(engine))
+    """}), "device-affinity")
+    assert findings == []
+
+
+def test_device_affinity_waiver_ok(tmp_path):
+    findings = of(run_on(tmp_path, {
+        "engine.py": ENGINE, "sched.py": SCHED, "worker.py": """
+        def replay_loop(engine):
+            # dlint: ok[device-affinity] worker replay loop IS the batching thread
+            engine.touch_cache()
+    """}), "device-affinity")
+    assert findings == []
+
+
+def test_loop_root_must_exist(tmp_path):
+    findings = of(run_on(tmp_path, {"sched.py": """
+        class Sched:
+            _dlint_loop_roots = ("_gone",)
+
+            def _run(self):
+                pass
+    """}), "device-affinity")
+    assert any("_gone" in f.message for f in findings)
+
+
+# -- rot-guards against the real tree ----------------------------------------
+
+
+def test_real_declarations_reach_the_model():
+    model = build_model(DECL_FILES)
+    assert set(model.kinds) == {
+        "kv-page", "session-record", "stream-entry", "journal-mark"
+    }
+    kv = model.kinds["kv-page"]
+    assert {"admit", "adopt", "paged_admit"} == set(kv.acquires)
+    assert "paged_finish" in kv.releases and "finish" in kv.releases
+    assert set(model.kinds["session-record"].acquires) == {"_mirror_admit"}
+    assert set(model.kinds["stream-entry"].acquires) == {"register"}
+    assert set(model.kinds["journal-mark"].releases) == {"record_finish"}
+    assert set(model.device_methods) == {
+        "apply_paged_admit", "copy_lane", "paged_unmap_all",
+        "export_kv_page", "import_kv_page",
+    }
+    assert model.loop_roots == {
+        ("scheduler.py", "ContinuousBatchingScheduler"): ("_run",)
+    }
+
+
+def test_real_loop_closure_reaches_dispatch():
+    """The _run -> _serve_loop -> ... closure must keep covering the
+    loop-thread methods that legitimately touch donated pytrees."""
+    model = build_model(DECL_FILES)
+    closure = model.loop_closure("scheduler.py", "ContinuousBatchingScheduler")
+    assert {"_run", "_serve_loop", "_start_request"} <= closure
+
+
+def test_real_transitive_releasers_span_wrappers():
+    """_fail_request reaches paged_finish through _paged_release — the
+    chain the interprocedural excuse depends on."""
+    model = build_model(DECL_FILES)
+    releasers = model.transitive_releasers("kv-page")
+    assert {"paged_finish", "_paged_release", "_fail_request"} <= releasers
+
+
+def test_resource_dot_draws_kinds_and_waivers(tmp_path):
+    model = build_model(DECL_FILES)
+    dot = resource_dot(model)
+    assert dot.startswith("digraph resources")
+    assert '"[kv-page]"' in dot and '"paged_admit" -> "[kv-page]"' in dot
+    # a waived transfer renders dashed, attributed to its owner function
+    (tmp_path / "pool.py").write_text(textwrap.dedent(POOL))
+    (tmp_path / "use.py").write_text(textwrap.dedent("""
+        def park(pool):
+            # dlint: ok[resource-balance] ticket transfer
+            raise RuntimeError(pool.grab())
+    """))
+    dot2 = resource_dot(build_model([tmp_path]))
+    assert 'style=dashed' in dot2 and '"park"' in dot2
+
+
+# -- reporting plumbing -------------------------------------------------------
+
+
+def test_finalize_findings_survive_changed_scope(tmp_path):
+    """--changed keeps cross-file findings: the leak is reported even
+    when the leaky file is NOT in the changed set."""
+    files = {"pool.py": POOL, "use.py": """
+        def leaky(pool, n):
+            h = pool.grab()
+            if n > 3:
+                raise ValueError("shed")
+            return h
+    """}
+    for rel, src in files.items():
+        (tmp_path / rel).write_text(textwrap.dedent(src), encoding="utf-8")
+    analyzer = Analyzer(default_checkers())
+    findings = analyzer.run(
+        [tmp_path], baseline=set(), root=tmp_path,
+        check_only={(tmp_path / "pool.py").resolve()},
+    )
+    assert len(of(findings, "resource-balance")) == 1
+
+
+def test_new_checks_listed_and_in_sarif(tmp_path, capsys):
+    assert dlint_main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    assert "resource-balance" in out and "device-affinity" in out
+    for rel, src in {"pool.py": POOL, "use.py": """
+        def leaky(pool, n):
+            h = pool.grab()
+            if n > 3:
+                raise ValueError("shed")
+            return h
+    """}.items():
+        (tmp_path / rel).write_text(textwrap.dedent(src), encoding="utf-8")
+    rc = dlint_main([str(tmp_path), "--no-baseline", "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert '"resource-balance"' in out  # ruleId + rule metadata
+
+
+def test_cli_resource_table_and_graph(capsys):
+    assert dlint_main(["--resource-table"]) == 0
+    out = capsys.readouterr().out
+    assert "kv-page" in out and "device-affine" in out
+    assert "loop roots ContinuousBatchingScheduler" in out
+    assert dlint_main(["--graph", "resources"]) == 0
+    assert capsys.readouterr().out.startswith("digraph resources")
