@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint lockgraph protocol jitcheck leakcheck hooks sanitize dryrun chaos fleet clean
+.PHONY: all native test verify lint lockgraph protocol jitcheck leakcheck kernelcheck hooks sanitize dryrun chaos fleet clean
 
 all: native
 
@@ -132,6 +132,21 @@ jitcheck:
 leakcheck:
 	python -m distributed_llama_multiusers_tpu.analysis --resource-table
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_leakcheck.py -q
+
+# Kernel-numerics gate (PERF.md "Promotion to shipping", ISSUE 18): the
+# interpret-mode parity pins for the shipping dequant path, standalone on
+# jax CPU — no TPU needed. Two layers: the kernel-lab oracle check (every
+# variant vs numpy dequant, single-chunk plane) and the pytest pins —
+# the i8blockdot (d_in, d_out, m) parity grid, shared-Q80Acts vs raw-x
+# parity per mode, the BLOCKDOT_MAX_M routing boundary, and the
+# selection-table semantics behind DLLAMA_DEQUANT=auto. Run it before
+# shipping ops/pallas_q40.py or ops/dequant_select.py changes; the same
+# pytest pins ride tier-1 via `verify` (the >=256-token decode-stream
+# token-identity pin is slow-marked — run it explicitly when touching
+# kernel numerics: pytest tests/test_pallas_q40.py -m slow).
+kernelcheck:
+	env JAX_PLATFORMS=cpu python scripts/kernel_lab3.py --check
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_q40.py tests/test_dequant_select.py -q -m 'not slow'
 
 # Install the git pre-commit hook running the diff-proportional lint
 # (`dlint --changed`, docs/LINT.md) so findings surface at commit time

@@ -1175,7 +1175,6 @@ def _phase_pod_serving(config, small):
         "pod_serving_mesh_tp": tp,
         "pod_serving_devices": n_dev,
         "pod_serving_ring_sync": ring_sync_enabled(),
-        "pod_serving_dequant_mode": os.environ.get("DLLAMA_DEQUANT", "v4"),
         "pod_serving_requests": n_requests,
         "pod_serving_lanes": n_lanes,
         "pod_serving_ttft_ms_p50": pct_ms(telemetry.ttft, 0.5),
@@ -2618,6 +2617,12 @@ def child_main() -> None:
         result = _phase_longctx(config, small)
     else:
         raise ValueError(f"unknown BENCH_PHASE {phase!r}")
+    # Every phase result carries the resolved dequant mode (and, under
+    # auto, the selection-table provenance + per-site resolutions) next to
+    # its tok/s numbers, so BENCH_LIVE.json rows are self-describing.
+    from distributed_llama_multiusers_tpu.ops.dequant_select import bench_stamp
+
+    result.update(bench_stamp(phase))
     print(json.dumps(result), flush=True)
 
 
@@ -2843,6 +2848,23 @@ def main() -> None:
                     })
                     merged["kernel_knobs"] = name
                     best_env = env
+                    if name.startswith("dequant_"):
+                        # a measured dequant win feeds the persisted
+                        # selection table so DLLAMA_DEQUANT=auto serves it
+                        # from the next warmup on (primary measures decode,
+                        # so the row lands in the decode m-class)
+                        try:
+                            from distributed_llama_multiusers_tpu.ops import (
+                                dequant_select,
+                            )
+
+                            dequant_select.record_win(
+                                "*", "*", "decode", name[len("dequant_"):],
+                                source="bench.py in-bench sweep (primary A/B"
+                                f", {merged.get('device_kind') or 'tpu'})",
+                            )
+                        except Exception as exc:  # table update is advisory
+                            errors.append(f"sweep[{name}]: record_win: {exc}")
                     # keep the headline ratio consistent with the adopted
                     # value (the 8b matched-model overwrite below may still
                     # supersede it)

@@ -135,6 +135,28 @@ def load_stack(args, n_lanes: int | None = None):
         )
     log("💿", "Weights loaded")
 
+    # dequant chain selection (ops/pallas_q40.py): the CLI flag overrides
+    # the DLLAMA_DEQUANT env default; both validate against the known-mode
+    # list. Applied HERE — before the engine exists and warmup compiles —
+    # because the mode is a static argname of the jitted matmul: a later
+    # switch would retrace every warmed family mid-serving.
+    from ..ops import pallas_q40 as _pq
+
+    if getattr(args, "dequant", None) is not None:
+        _pq.set_dequant_mode(args.dequant)
+    if _pq.DEQUANT_MODE == "auto":
+        from ..ops.dequant_select import freeze_for_serving
+
+        prov = freeze_for_serving() or {}
+        log("🎛️", f"Dequant mode: auto — per-site selection from "
+                  f"{prov.get('path', 'ops/dequant_table.json')} "
+                  f"(v{prov.get('version')}, {prov.get('rows')} rows, "
+                  f"updated {prov.get('updated')}); resolved at warmup "
+                  "trace time")
+    elif _pq.DEQUANT_MODE != "v4":
+        log("🎛️", f"Dequant mode: {_pq.DEQUANT_MODE} "
+                  "(--dequant / DLLAMA_DEQUANT)")
+
     from ..quants.codec import FloatType
 
     emulate_q80 = args.buffer_float_type == FloatType.Q80

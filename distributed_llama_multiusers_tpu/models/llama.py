@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from ..formats.model_file import HiddenAct
 from ..ops.activations import gelu, silu
-from ..ops.linear import matmul
+from ..ops.linear import matmul, shared_q80_acts
 from ..ops.norm import rms_norm
 from ..ops.rope import apply_rope
 from ..jax_compat import shard_map
@@ -426,6 +426,21 @@ def llama_forward(
             return q80_sync_matmul(y, w, mesh)
         return maybe_qdq(matmul(y, w))
 
+    # Shared Q80 activation operands (ops/pallas_q40.Q80Acts): wq/wk/wv
+    # consume one normed x and w1/w3 another, so each site builds its
+    # activation-quant + relayout operands ONCE instead of once per
+    # matmul (one build feeds three dots at the attention site, two at
+    # the FFN site). Single-chip only: under a mesh the matmuls go
+    # through the GSPMD custom_partitioning wrapper, which takes raw
+    # activations. shared_q80_acts itself no-ops when the Pallas kernel
+    # is off, so every other path sees the plain activation.
+    from ..quants.packed import PackedQ40
+
+    share = mesh is None and isinstance(
+        getattr(params.layers, "wq", None), PackedQ40
+    )
+    share_q80 = shared_q80_acts if share else (lambda y: y)
+
     x = params.embedding[tokens]  # [B, T, dim]
     lane_idx = jnp.arange(b)[:, None]  # [B, 1]
 
@@ -459,7 +474,7 @@ def llama_forward(
         dtype = x.dtype
 
         y = rms_norm(x, lp.rms_att, eps)
-        yq = maybe_qdq(y)
+        yq = share_q80(maybe_qdq(y))  # one operand build for wq/wk/wv
         q = _maybe_bias(matmul(yq, lp.wq), lp.bq).reshape(b, t, n_heads, hd)
         k = _maybe_bias(matmul(yq, lp.wk), lp.bk).reshape(b, t, n_kv, hd)
         v = _maybe_bias(matmul(yq, lp.wv), lp.bv).reshape(b, t, n_kv, hd)
@@ -531,8 +546,9 @@ def llama_forward(
             )
             x = x + maybe_qdq(d)
         else:
-            g = act_fn(matmul(yq, lp.w1))
-            u = matmul(yq, lp.w3)
+            yqs = share_q80(yq)  # one operand build for w1/w3
+            g = act_fn(matmul(yqs, lp.w1))
+            u = matmul(yqs, lp.w3)
             x = x + synced_matmul(maybe_qdq(g * u), lp.w2)
 
         return x, (k_cache, v_cache)

@@ -33,10 +33,25 @@ _pallas_interpret = False
 # dots on TPU (the bench ablation knob).
 _pallas_w_dtype = None
 
+# Operand sharing (ops/pallas_q40.Q80Acts): llama_forward builds the
+# activation-quant/relayout operands once per distinct input and feeds
+# every matmul sharing it. Off switch for A/B and bisection only — the
+# shared and per-call bundles are the same traced graph.
+_shared_acts_enabled = os.environ.get("DLLAMA_SHARED_ACTS", "on") != "off"
+
 
 def set_pallas_enabled(enabled: bool) -> None:
     global _pallas_enabled
     _pallas_enabled = enabled
+
+
+def set_shared_acts(enabled: bool) -> None:
+    global _shared_acts_enabled
+    _shared_acts_enabled = enabled
+
+
+def shared_acts_enabled() -> bool:
+    return _shared_acts_enabled
 
 
 def set_pallas_interpret(enabled: bool) -> None:
@@ -76,16 +91,59 @@ def pallas_kernel_active() -> bool:
     return _pallas_enabled and (_pallas_interpret or _pallas_q40_matmul() is not None)
 
 
-def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
-    """y = x @ w for dense [.., d_in, d_out] arrays or PackedQ40 weights."""
+def shared_q80_acts(x: jnp.ndarray):
+    """Build the shared Q80/relayout operand bundle for ``x``, or return x
+    unchanged when sharing cannot engage (kernel off, sharing disabled, or
+    a d_in that does not cover whole quant blocks). Callers pass the
+    result to ``matmul`` exactly like a raw activation."""
+    if not (_shared_acts_enabled and pallas_kernel_active()):
+        return x
+    if x.shape[-1] % 32 != 0:
+        return x
+    try:
+        from .pallas_q40 import make_q80_acts
+    except ImportError:
+        return x
+    return make_q80_acts(x, shared=True)
+
+
+def _raw_x(x):
+    """Unwrap a Q80Acts bundle to its original activation for every
+    non-kernel path (dense weights, XLA fallback)."""
+    try:
+        from .pallas_q40 import Q80Acts
+    except ImportError:
+        return x
+    return x.x if isinstance(x, Q80Acts) else x
+
+
+def matmul(x, w) -> jnp.ndarray:
+    """y = x @ w for dense [.., d_in, d_out] arrays or PackedQ40 weights.
+    ``x`` may be a Q80Acts bundle from ``shared_q80_acts``: the Pallas
+    path consumes the prebuilt operands directly; every other path falls
+    back to the bundle's original activation."""
     if isinstance(w, PackedQ40):
         if w.packed.ndim == 2 and pallas_kernel_active():
-            from .pallas_q40 import q40_matmul_partitioned
+            from .pallas_q40 import (
+                Q80Acts,
+                pallas_supports,
+                q40_matmul_pallas,
+                q40_matmul_partitioned,
+            )
 
             kw = {} if _pallas_w_dtype is None else {"w_dtype": _pallas_w_dtype}
+            if isinstance(x, Q80Acts):
+                # prebuilt operands skip the GSPMD wrapper: sharing is the
+                # single-chip (mesh-free) fast path, and the bundle's
+                # layouts are unsharded by construction
+                if pallas_supports(w) and x.d_in == w.d_in:
+                    return q40_matmul_pallas(
+                        x, w, interpret=_pallas_interpret, **kw
+                    )
+                x = x.x
             return q40_matmul_partitioned(x, w, interpret=_pallas_interpret, **kw)
-        return q40_matmul_xla(x, w)
-    return x @ w
+        return q40_matmul_xla(_raw_x(x), w)
+    return _raw_x(x) @ w
 
 
 def q40_matmul_local(x: jnp.ndarray, w: PackedQ40) -> jnp.ndarray:

@@ -42,6 +42,7 @@ on TPU.
 from __future__ import annotations
 
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -91,10 +92,48 @@ TARGET_BLOCK_BYTES = int(
 #              test; same m cap as blockdot.
 # Exact-f32 dots (w_dtype=f32: parity gate, interpret tests) always use the
 # v4 f32 chain regardless of this knob.
-DEQUANT_MODE = _os.environ.get("DLLAMA_DEQUANT", "v4")
 DEQUANT_MODES = ("v4", "bf16chain", "repeat", "u8chain", "blockdot",
                  "i8blockdot")
+# "auto" is selectable but not a kernel mode: it resolves per (d_in, d_out,
+# m-class) from the persisted selection table (ops/dequant_select.py) inside
+# q40_matmul_pallas, at trace time, so every family still compiles once.
+SELECTABLE_MODES = DEQUANT_MODES + ("auto",)
+
+
+def _env_dequant_default() -> str:
+    """DLLAMA_DEQUANT, validated at READ time. A typo'd value must fail
+    loudly here: the slab kernel's mode= else-branch would otherwise
+    silently run the v4 chain under the wrong name."""
+    mode = _os.environ.get("DLLAMA_DEQUANT", "v4")
+    if mode not in SELECTABLE_MODES:
+        raise ValueError(
+            f"DLLAMA_DEQUANT={mode!r} is not a known dequant mode; "
+            f"one of {SELECTABLE_MODES}"
+        )
+    return mode
+
+
+DEQUANT_MODE = _env_dequant_default()
 BLOCKDOT_MAX_M = 32  # above this, the post-scale FMA outweighs the savings
+
+# Trace-time counters (host side: these python bodies run only while jax
+# traces a NEW program, so steady-state jit-cache hits add nothing). They
+# are the operand-sharing and compile-churn witnesses: `shared_builds` /
+# `shared_consumes` pin that one Q80Acts build feeds every matmul sharing
+# its input (llama_forward: wq/wk/wv = 1 build, w1/w3 = 1 build per step),
+# and `impl_traces` holding still across repeated calls is the
+# no-recompile signal tests assert across the BLOCKDOT_MAX_M boundary.
+TRACE_STATS = {
+    "acts_builds": 0,      # make_q80_acts executions (any caller)
+    "shared_builds": 0,    # ... with shared=True (the models/llama.py hoist)
+    "shared_consumes": 0,  # q40_matmul_pallas calls fed a prebuilt Q80Acts
+    "impl_traces": 0,      # kernel-body traces (one per compiled family)
+}
+
+
+def reset_trace_stats() -> None:
+    for k in TRACE_STATS:
+        TRACE_STATS[k] = 0
 
 # The one shared DMA-geometry sweep table: (single-slab ceiling, k-chunk
 # target) in bytes, keyed by a stable name. scripts/kernel_sweep.py runs
@@ -197,12 +236,16 @@ def _final_writeback(k, n_k, out_ref, acc_ref):
 
 
 def set_dequant_mode(mode: str | None) -> None:
-    """Select the bf16-path dequant variant (None -> env/default). The mode
-    is a static argument of the jitted matmul, so switching retraces."""
+    """Select the bf16-path dequant variant (None -> env/default; "auto" ->
+    per-site table resolution, ops/dequant_select.py). The mode is a static
+    argument of the jitted matmul, so switching retraces — resolve before
+    warmup_engine, never mid-serving."""
     global DEQUANT_MODE
-    if mode is not None and mode not in DEQUANT_MODES:
-        raise ValueError(f"unknown dequant mode {mode!r}; one of {DEQUANT_MODES}")
-    DEQUANT_MODE = mode or _os.environ.get("DLLAMA_DEQUANT", "v4")
+    if mode is not None and mode not in SELECTABLE_MODES:
+        raise ValueError(
+            f"unknown dequant mode {mode!r}; one of {SELECTABLE_MODES}"
+        )
+    DEQUANT_MODE = mode or _env_dequant_default()
 
 
 def _q40_slab_kernel(x_lo_ref, x_hi_ref, bsum_t_ref, packed_ref, scales_ref,
@@ -403,9 +446,106 @@ def _resolve_w_dtype(w_dtype, interpret: bool):
     return jnp.float32 if interpret else jnp.bfloat16
 
 
-def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
+def _m_geometry(m: int) -> tuple[int, int]:
+    """(m_pad, m_tile): x rows padded to ROW_ALIGN, tiled at M_TILE."""
+    m_pad = max(ROW_ALIGN, ((m + ROW_ALIGN - 1) // ROW_ALIGN) * ROW_ALIGN)
+    m_tile = min(M_TILE, m_pad)
+    if m_pad % m_tile != 0:
+        m_pad = ((m_pad + m_tile - 1) // m_tile) * m_tile
+    return m_pad, m_tile
+
+
+class Q80Acts(NamedTuple):
+    """Shared activation operands for the Q40 matmul: built ONCE per
+    distinct input and consumed by every matmul sharing it — llama_forward's
+    wq/wk/wv share one normed x and w1/w3 another, so the per-step
+    activation-quant + relayout VPU work drops to one build per site
+    instead of one per call.
+
+    Every kernel layout is materialized eagerly — the f32 nibble halves
+    (slab chains), their transposes (blockdot), the Q80 per-block int8
+    quantization with interleaved bsum/sx aux (i8blockdot) — because under
+    jit the layouts the resolved mode does not touch are dead code XLA
+    eliminates per compiled program. `x` keeps the ORIGINAL [..., d_in]
+    input: it is the shape/dtype source and the operand for the XLA
+    fallback when a consumer's weight has no supported tiling."""
+
+    x: jnp.ndarray        # original input, [..., d_in]
+    x_lo: jnp.ndarray     # [m_pad, half] f32 block-local low-nibble half
+    x_hi: jnp.ndarray     # [m_pad, half] f32 high half
+    x_lo_t: jnp.ndarray   # [half, m_pad] f32 (blockdot: block rows on sublanes)
+    x_hi_t: jnp.ndarray
+    bsum_t: jnp.ndarray   # [n_blk, m_pad] f32 per-block sums (folded -8)
+    xq_lo_t: jnp.ndarray  # [half, m_pad] int8 Q80-quantized halves
+    xq_hi_t: jnp.ndarray
+    aux_t: jnp.ndarray    # [2*n_blk, m_pad] f32; aux[2b]=bsum[b], aux[2b+1]=sx[b]
+
+    @property
+    def d_in(self) -> int:
+        return self.x.shape[-1]
+
+    @property
+    def m(self) -> int:
+        m = 1
+        for s in self.x.shape[:-1]:
+            m *= s
+        return m
+
+
+def make_q80_acts(x: jnp.ndarray, shared: bool = False) -> Q80Acts:
+    """Build the Q40-matmul activation operand bundle for `x` (idempotent
+    on an existing bundle). O(m*d_in) VPU work, negligible next to the
+    weight read — but when one input feeds several matmuls the per-call
+    prep (f32 cast + pad, nibble split, transposes, Q80 quantization +
+    aux interleave) used to be traced into EVERY call; hoisting it here
+    runs it once per distinct input. bsum stays TRANSPOSED [n_blk, m] so
+    its lane dim is m — Pallas lane-dim blocks must be multiples of 128
+    or the full extent, and m tiles are either all of m_pad or 256-wide."""
+    if isinstance(x, Q80Acts):
+        return x
+    d_in = x.shape[-1]
+    if d_in % 32 != 0:
+        raise ValueError(f"d_in={d_in} must cover whole 32-wide quant blocks")
+    TRACE_STATS["acts_builds"] += 1
+    if shared:
+        TRACE_STATS["shared_builds"] += 1
+    half = d_in // 2
+    n_blk = d_in // 32
+    m = 1
+    for s in x.shape[:-1]:
+        m *= s
+    xf = x.reshape(m, d_in).astype(jnp.float32)
+    m_pad, _ = _m_geometry(m)
+    if m_pad != m:
+        xf = jnp.pad(xf, ((0, m_pad - m), (0, 0)))
+
+    xb = xf.reshape(m_pad, n_blk, 2, 16)
+    x_lo = xb[:, :, 0, :].reshape(m_pad, half)
+    x_hi = xb[:, :, 1, :].reshape(m_pad, half)
+
+    xq3 = xf.reshape(m_pad, n_blk, 32)
+    bsum = xq3.sum(axis=2)  # EXACT f32 sums: the folded -8 stays exact
+    sx = jnp.maximum(jnp.abs(xq3).max(axis=2), 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(xq3 / sx[:, :, None]), -127, 127).astype(jnp.int8)
+
+    return Q80Acts(
+        x=x,
+        x_lo=x_lo,
+        x_hi=x_hi,
+        x_lo_t=x_lo.T,
+        x_hi_t=x_hi.T,
+        bsum_t=bsum.T,
+        xq_lo_t=xq[:, :, :16].reshape(m_pad, half).T,
+        xq_hi_t=xq[:, :, 16:].reshape(m_pad, half).T,
+        aux_t=jnp.stack([bsum, sx], axis=2).reshape(m_pad, n_blk * 2).T,
+    )
+
+
+def q40_matmul_pallas(x, w: PackedQ40, interpret: bool = False,
                       w_dtype=None) -> jnp.ndarray:
-    """y = x @ dequant(w). x: [..., d_in]; returns [..., d_out] in x.dtype.
+    """y = x @ dequant(w). x: [..., d_in] array OR a prebuilt ``Q80Acts``
+    bundle (operand sharing across matmuls); returns [..., d_out] in the
+    input's dtype.
 
     ``w_dtype``: the dot's compute dtype — applied to the dequantized
     weight planes AND the x operand. None (the default) resolves to exact
@@ -414,27 +554,57 @@ def q40_matmul_pallas(x: jnp.ndarray, w: PackedQ40, interpret: bool = False,
     more mantissa); explicit bf16 under interpret is the ablation/test
     knob. The bf16 path's dequant arithmetic variant comes from
     ``DEQUANT_MODE`` (env DLLAMA_DEQUANT / set_dequant_mode), resolved
-    here so switching modes retraces; exact-f32 dots always use the v4
-    f32 chain; blockdot's post-scale FMA scales with m, so large-m calls
-    (prefill/training) fall back to bf16chain."""
+    here so switching modes retraces; "auto" resolves per (d_in, d_out,
+    m-class) from the persisted selection table (ops/dequant_select.py),
+    deterministically at trace time, so a warmed family never re-resolves.
+    Exact-f32 dots always use the v4 f32 chain; blockdot's post-scale FMA
+    scales with m, so large-m calls (prefill/training) fall back to
+    bf16chain."""
     w_dtype_r = _resolve_w_dtype(w_dtype, interpret)
+    acts = x if isinstance(x, Q80Acts) else None
+    xr = acts.x if acts is not None else x
+    m = 1
+    for s_ in xr.shape[:-1]:
+        m *= s_
     mode = DEQUANT_MODE if w_dtype_r == jnp.bfloat16 else "v4"
-    if mode in ("blockdot", "i8blockdot"):
-        m = 1
-        for s_ in x.shape[:-1]:
-            m *= s_
-        if m > BLOCKDOT_MAX_M:
-            mode = "bf16chain"
+    if mode == "auto":
+        from .dequant_select import resolve_mode
+
+        mode = resolve_mode(w.d_in, w.d_out, m)
+    if mode in ("blockdot", "i8blockdot") and m > BLOCKDOT_MAX_M:
+        mode = "bf16chain"
+    if acts is not None:
+        TRACE_STATS["shared_consumes"] += 1
+        return _q40_matmul_acts_impl(acts, w, interpret, w_dtype_r, mode)
     return _q40_matmul_pallas_impl(x, w, interpret, w_dtype_r, mode)
 
 
 @partial(jax.jit, static_argnames=("interpret", "w_dtype", "mode"))
 def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
                             mode) -> jnp.ndarray:
+    """Raw-x entry: builds the operand bundle inside the same trace (XLA
+    DCEs the layouts `mode` does not touch), then runs the kernel."""
+    return _q40_matmul_core(make_q80_acts(x), w, interpret, w_dtype, mode)
+
+
+@partial(jax.jit, static_argnames=("interpret", "w_dtype", "mode"))
+def _q40_matmul_acts_impl(acts: Q80Acts, w: PackedQ40, interpret, w_dtype,
+                          mode) -> jnp.ndarray:
+    """Prebuilt-operand entry. Q80Acts is a NamedTuple pytree, so inside an
+    outer trace the bundle stays symbolic and one build feeds every
+    consumer without re-tracing the prep."""
+    return _q40_matmul_core(acts, w, interpret, w_dtype, mode)
+
+
+def _q40_matmul_core(acts: Q80Acts, w: PackedQ40, interpret, w_dtype,
+                     mode) -> jnp.ndarray:
+    TRACE_STATS["impl_traces"] += 1
     if w.packed.ndim != 2:
         raise ValueError(f"expected 2D packed weight, got {w.packed.shape}")
     d_in, d_out = w.d_in, w.d_out
     half = d_in // 2
+    if acts.d_in != d_in:
+        raise ValueError(f"operand d_in {acts.d_in} != weight d_in {d_in}")
     plan = _plan_blocks(d_in, d_out)
     if plan is None:
         raise ValueError(
@@ -444,31 +614,10 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
     sub = _sub_tiles(w_tile)
     n_k = half // rows
 
-    lead = x.shape[:-1]
-    m = 1
-    for s in lead:
-        m *= s
-
-    xf = x.reshape(m, d_in).astype(jnp.float32)
-    m_pad = max(ROW_ALIGN, ((m + ROW_ALIGN - 1) // ROW_ALIGN) * ROW_ALIGN)
+    lead = acts.x.shape[:-1]
+    m = acts.m
+    m_pad = acts.x_lo.shape[0]
     m_tile = min(M_TILE, m_pad)
-    if m_pad % m_tile != 0:
-        m_pad = ((m_pad + m_tile - 1) // m_tile) * m_tile
-    if m_pad != m:
-        xf = jnp.pad(xf, ((0, m_pad - m), (0, 0)))
-
-    # kernel-side layout prep (fused into the surrounding jit; O(m*d_in),
-    # negligible next to the weight read): split x's columns into the
-    # block-local nibble halves matching the packed planes, and precompute
-    # per-quant-block sums for the folded -8 correction. bsum is kept
-    # TRANSPOSED [n_blk, m] so its lane dim is m — Pallas lane-dim blocks
-    # must be multiples of 128 or the full extent, and m tiles are either
-    # the whole of m_pad or 256-wide.
-    n_blk_total = d_in // 32
-    xb = xf.reshape(m_pad, n_blk_total, 2, 16)
-    x_lo = xb[:, :, 0, :].reshape(m_pad, half)
-    x_hi = xb[:, :, 1, :].reshape(m_pad, half)
-    bsum_t = xf.reshape(m_pad, n_blk_total, 32).sum(axis=2).T
 
     grid = (m_pad // m_tile, d_out // w_tile, n_k)
 
@@ -479,36 +628,29 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
         # x TRANSPOSED [rows, m]: the kernel slices 16-row (one quant
         # block) ranges, which must land on the sublane axis — sub-128
         # lane slices would relayout
-        xa, xb_ = x_lo.T, x_hi.T
-        aux = bsum_t
+        xa, xb_ = acts.x_lo_t, acts.x_hi_t
+        aux = acts.bsum_t
         x_spec = pl.BlockSpec((rows, m_tile), lambda i, j, k: (k, i))
         kernel = partial(_q40_blockdot_kernel, sub_tiles=sub, n_k=n_k)
     elif mode == "i8blockdot":
-        # Q80-style per-block activation quantization, in the surrounding
-        # jit (O(m*d_in)); x TRANSPOSED like blockdot; bsum (EXACT f32
-        # sums) and the activation scales interleave on the sublane axis
-        xq3 = xf.reshape(m_pad, n_blk_total, 32)
-        sx = jnp.maximum(jnp.abs(xq3).max(axis=2), 1e-8) / 127.0
-        xq = jnp.clip(
-            jnp.round(xq3 / sx[:, :, None]), -127, 127
-        ).astype(jnp.int8)
-        xa = xq[:, :, :16].reshape(m_pad, half).T
-        xb_ = xq[:, :, 16:].reshape(m_pad, half).T
-        aux = jnp.stack([bsum_t.T, sx], axis=2).reshape(
-            m_pad, n_blk_total * 2
-        ).T  # aux[2b] = bsum[b], aux[2b+1] = sx[b]
+        # Q80-quantized activations from the bundle; x TRANSPOSED like
+        # blockdot; bsum (EXACT f32 sums) and the activation scales
+        # interleave on the sublane axis
+        xa, xb_ = acts.xq_lo_t, acts.xq_hi_t
+        aux = acts.aux_t
         aux_spec = pl.BlockSpec(
             ((rows // 16) * 2, m_tile), lambda i, j, k: (k, i)
         )
         x_spec = pl.BlockSpec((rows, m_tile), lambda i, j, k: (k, i))
         kernel = partial(_q40_i8blockdot_kernel, sub_tiles=sub, n_k=n_k)
     else:
-        xa, xb_ = x_lo, x_hi
-        aux = bsum_t
+        xa, xb_ = acts.x_lo, acts.x_hi
+        aux = acts.bsum_t
         x_spec = pl.BlockSpec((m_tile, rows), lambda i, j, k: (i, k))
         kernel = partial(_q40_slab_kernel, w_dtype=w_dtype, sub_tiles=sub,
                          n_k=n_k, mode=mode)
 
+    out_dtype = acts.x.dtype
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -520,7 +662,7 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
             pl.BlockSpec((rows // 16, w_tile), lambda i, j, k: (k, j)),
         ],
         out_specs=pl.BlockSpec((m_tile, w_tile), lambda i, j, k: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m_pad, d_out), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((m_pad, d_out), out_dtype),
         scratch_shapes=[
             pltpu.VMEM((m_tile, w_tile if n_k > 1 else SUB_TILE), jnp.float32)
         ],
@@ -530,7 +672,7 @@ def _q40_matmul_pallas_impl(x: jnp.ndarray, w: PackedQ40, interpret, w_dtype,
         cost_estimate=pl.CostEstimate(
             flops=2 * m_pad * d_in * d_out,
             bytes_accessed=d_in * d_out // 2 + (d_in // 32) * d_out * 2
-            + m_pad * d_in * 4 + m_pad * d_out * x.dtype.itemsize,
+            + m_pad * d_in * 4 + m_pad * d_out * out_dtype.itemsize,
             transcendentals=0,
         ),
         interpret=interpret,

@@ -2017,6 +2017,12 @@ class InferenceEngine:
             jnp.asarray(z),
         ).compile()
         stats = collective_stats_of_compiled(compiled)
+        # stamp the dequant path the compiled step bakes in (static
+        # argname): per-step traffic numbers are only comparable across
+        # runs when the kernel mode they were measured under is recorded
+        from ..ops.dequant_select import dequant_stats
+
+        stats.update(dequant_stats())
         # keep the executable for dispatch: decode shapes never change, so
         # this one AOT compile replaces the jit path's own compile
         self._decode_exec = compiled
@@ -2266,6 +2272,15 @@ def warmup_engine(
     restored afterwards."""
     n = engine.n_lanes
     z = np.zeros(n, np.int32)
+    # resolve + pin the dequant selection BEFORE anything compiles: the
+    # mode is a static argname of the Q40 matmul jit, so under
+    # DLLAMA_DEQUANT=auto the per-site table answers are baked into the
+    # programs warmed below, and a post-warmup table change would retrace
+    # every family mid-serving — freeze_for_serving makes that a loud
+    # error instead (ops/dequant_select.py)
+    from ..ops.dequant_select import dequant_stats, freeze_for_serving
+
+    freeze_for_serving()
     # warmup's own compiles are the sanctioned ones: pause the recompile
     # witness for the duration (tests warm several engines per process —
     # one engine's warmup must not fire another's armed witness); arming
@@ -2453,4 +2468,8 @@ def warmup_engine(
         # means DLLAMA_JITCHECK=1 will raise on any post-warmup compile
         jitcheck_strict=jitcheck.enabled(),
         seq_len=engine.config.seq_len,
+        # the dequant path every warmed program baked in: the configured
+        # knob plus (under auto) the per-site table resolutions recorded
+        # while the families above traced
+        **dequant_stats(),
     )

@@ -419,6 +419,14 @@ class ApiServer:
         # zero). bridge_stats republishes resources_live as a labelled
         # gauge and delta-feeds dllama_resource_leaks_total (telemetry/hub)
         out.update(leakcheck.stats())
+        # dequant path attribution (ops/dequant_select.py): the configured
+        # DLLAMA_DEQUANT knob, and — under auto — the per-(d_in, d_out,
+        # m-class) modes resolved at warmup trace time plus the selection
+        # table's provenance, so a /stats snapshot pins WHICH kernel chain
+        # produced the throughput it reports
+        from ..ops.dequant_select import dequant_stats
+
+        out.update(dequant_stats())
         leak_counts = getattr(sched, "leak_counts", None)
         if callable(leak_counts):
             out["resources_live"] = leak_counts()

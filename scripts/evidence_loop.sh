@@ -93,8 +93,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     merge_disagg_smoke "$REPO/BENCH_LIVE.json"
     echo "TPU artifact banked" >> "$OUT/status"
     # bonus evidence while the tunnel is up; each has its own timeout
+    # --update-table: a winning dequant_* combo is written back into
+    # ops/dequant_table.json so DLLAMA_DEQUANT=auto serves the measured
+    # winner from the next start (the foreground session commits it)
     timeout "${SWEEP_BUDGET_S:-1200}" python scripts/kernel_sweep.py 240 \
-      > "$OUT/kernel_sweep.log" 2>&1
+      --update-table > "$OUT/kernel_sweep.log" 2>&1
     echo "kernel_sweep rc=$?" >> "$OUT/status"
     timeout "${PROBE_BUDGET_S:-600}" python scripts/stage_probe.py \
       > "$OUT/stage_probe.log" 2>&1

@@ -24,6 +24,12 @@ XLA-level (no Pallas) int4-resident alternatives:
 
 Run on TPU:  python scripts/kernel_lab3.py [d_in] [d_out] [L] [reps]
 Correctness: python scripts/kernel_lab3.py --check   (interpret mode, CPU)
+Adopt:       python scripts/kernel_lab3.py [shape...] --adopt
+
+--adopt makes the lab adopt-and-verify: after timing, the fastest product
+variant is re-verified against the numpy oracle (the --check gate) and
+then recorded into ops/dequant_table.json as a per-(d_in, d_out) decode
+row for DLLAMA_DEQUANT=auto to pick up at the next serving start.
 """
 
 from __future__ import annotations
@@ -37,6 +43,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# jax < 0.6 spells pltpu.CompilerParams "TPUCompilerParams" (same kwargs)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 sys.path.insert(0, ".")
 
@@ -261,6 +270,17 @@ KERNELS = {
 }
 # i8blockdot is special-cased (int8 x operands + interleaved bsum/sx aux)
 
+# lab variant -> shipping DEQUANT_MODES name, for --adopt (the XLA int4
+# probes have no product counterpart and are never adopted)
+ADOPT_MODES = {
+    "full_v4": "v4",
+    "full_bf16chain": "bf16chain",
+    "full_repeat": "repeat",
+    "full_u8nib": "u8chain",
+    "full_blockdot": "blockdot",
+    "full_i8blockdot": "i8blockdot",
+}
+
 
 def _call_i8blockdot(xf, packed, sbits, d_in, d_out, chunk, tile):
     half = d_in // 2
@@ -279,7 +299,7 @@ def _call_i8blockdot(xf, packed, sbits, d_in, d_out, chunk, tile):
         ],
         out_specs=pl.BlockSpec((M, tile), lambda j, k: (0, j)),
         out_shape=jax.ShapeDtypeStruct((M, d_out), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=_INTERPRET,
@@ -336,7 +356,7 @@ def _call_kernel(name, xf, packed, sbits, d_in, d_out, chunk, tile):
         ],
         out_specs=pl.BlockSpec((M, tile), lambda j, k: (0, j)),
         out_shape=jax.ShapeDtypeStruct((M, d_out), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=_INTERPRET,
@@ -387,11 +407,13 @@ def main():
     if "--check" in sys.argv:
         check()
         return
-    d_in = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-    d_out = int(sys.argv[2]) if len(sys.argv) > 2 else 14336
-    L = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    adopt = "--adopt" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    d_in = int(args[0]) if len(args) > 0 else 4096
+    d_out = int(args[1]) if len(args) > 1 else 14336
+    L = int(args[2]) if len(args) > 2 else 8
     global _REPS
-    _REPS = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    _REPS = int(args[3]) if len(args) > 3 else 8
     half = d_in // 2
     n_blk_all = d_in // 32
 
@@ -415,10 +437,11 @@ def main():
     s_spec = pl.BlockSpec((1, CHUNK // 32, TILE), lambda l, j, k: (l, k, j))
     o_spec = pl.BlockSpec((M, TILE), lambda l, j, k: (0, j))
     o_shape = jax.ShapeDtypeStruct((M, d_out), jnp.float32)
-    params = pltpu.CompilerParams(
+    params = _CompilerParams(
         dimension_semantics=("arbitrary", "parallel", "arbitrary"),
     )
 
+    times: dict = {}
     for name, (kern, transposed) in KERNELS.items():
         if transposed:
             xa, xb_ = x_lo.T, x_hi.T
@@ -440,7 +463,7 @@ def main():
                 compiler_params=params,
             )(t, xa, xb_, bsum_t, packed, sbits)
 
-        timeit(name, call, pbytes)
+        times[name] = timeit(name, call, pbytes)
 
     # ---- i8blockdot: int8 MXU dots on Q80-quantized activations -----------
     xq_lo, xq_hi, aux = _quantize_x_blocks(np.asarray(xf), d_in)
@@ -459,7 +482,7 @@ def main():
             compiler_params=params,
         )(t, xq_lo, xq_hi, aux, packed, sbits)
 
-    timeit("full_i8blockdot", call_i8, pbytes)
+    times["full_i8blockdot"] = timeit("full_i8blockdot", call_i8, pbytes)
 
     # ---- XLA-level int4 alternatives (no Pallas) --------------------------
     try:
@@ -505,6 +528,33 @@ def main():
     except Exception as e:  # noqa: BLE001
         print(f"xla_int4: unavailable ({type(e).__name__}: {str(e)[:120]})")
 
+    if adopt:
+        _adopt(times, d_in, d_out)
+
+
+def _adopt(times, d_in, d_out):
+    """--adopt: verify the fastest product variant against the numpy
+    oracle (the --check gate; exits non-zero on parity failure), then
+    record it into the persisted selection table as a per-(d_in, d_out)
+    decode row (M=8 here is squarely decode-class)."""
+    timed = {ADOPT_MODES[n]: t for n, t in times.items()
+             if t is not None and n in ADOPT_MODES}
+    if not timed:
+        print("ADOPT: no product variant timed; nothing recorded")
+        return
+    mode = min(timed, key=timed.get)
+    print(f"ADOPT: fastest product variant = {mode} "
+          f"({timed[mode] * 1e3:.3f} ms/pass); verifying before recording")
+    check()
+    from distributed_llama_multiusers_tpu.ops.dequant_select import record_win
+
+    path = record_win(
+        d_in, d_out, "decode", mode,
+        source=f"scripts/kernel_lab3.py --adopt "
+               f"({timed[mode] * 1e3:.3f} ms/pass, M={M})",
+    )
+    print(f"TABLE: {d_in}x{d_out}/decode -> {mode} recorded in {path}")
+
 
 def timeit(name, build_call, bytes_per_pass, reps=None):
     reps = reps if reps is not None else _REPS
@@ -517,7 +567,7 @@ def timeit(name, build_call, bytes_per_pass, reps=None):
             return out.reshape(-1)[0].astype(jnp.float32) * 1e-30
         return jax.lax.fori_loop(0, reps, body, seed)
 
-    _report(name, loop, bytes_per_pass, reps)
+    return _report(name, loop, bytes_per_pass, reps)
 
 
 def timeit_xla(name, fn, bytes_per_pass, reps=None):
@@ -529,7 +579,7 @@ def timeit_xla(name, fn, bytes_per_pass, reps=None):
             return fn(acc)
         return jax.lax.fori_loop(0, reps, body, seed)
 
-    _report(name, loop, bytes_per_pass, reps)
+    return _report(name, loop, bytes_per_pass, reps)
 
 
 def _report(name, loop, bytes_per_pass, reps):
@@ -544,9 +594,11 @@ def _report(name, loop, bytes_per_pass, reps):
         gbs = bytes_per_pass / sec / 1e9
         print(f"{name:16s} {sec * 1e3:8.3f} ms  {gbs:7.1f} GB/s "
               f"({gbs / HBM_GB_S * 100:5.1f}% HBM)", flush=True)
+        return sec
     except Exception as e:  # noqa: BLE001
         print(f"{name:16s} FAILED: {type(e).__name__}: {str(e)[:140]}",
               flush=True)
+        return None
 
 
 if __name__ == "__main__":

@@ -4,7 +4,11 @@ Runs `BENCH_CHILD=1 BENCH_PHASE=primary python bench.py` in a child process
 per configuration (the knobs are read at module import, so each combo needs
 a fresh interpreter) and reports decode tok/s + hbm_util per combo.
 
-Run: python scripts/kernel_sweep.py [timeout_per_combo_s]
+Run: python scripts/kernel_sweep.py [timeout_per_combo_s] [--update-table]
+
+With --update-table, a winning dequant_* candidate is written back into
+ops/dequant_table.json as a wildcard decode-class row, so the next
+DLLAMA_DEQUANT=auto serving start resolves to the measured winner.
 """
 
 from __future__ import annotations
@@ -42,7 +46,10 @@ CANDIDATES: dict[str, dict] = {
 
 
 def main():
-    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
+    flags = [a for a in sys.argv[1:] if a.startswith("--")]
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    update_table = "--update-table" in flags
+    budget = float(args[0]) if args else 420.0
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = {}
     for name, knobs in CANDIDATES.items():
@@ -78,6 +85,20 @@ def main():
     if best:
         print(f"BEST: {best[0]} -> {best[1]['value']} tok/s "
               f"(hbm_util {best[1].get('hbm_util')})")
+        if update_table and best[0].startswith("dequant_"):
+            # feed the measured winner back into the persisted selection
+            # table (the primary phase measures decode throughput, so the
+            # row lands in the decode m-class)
+            from distributed_llama_multiusers_tpu.ops.dequant_select import (
+                record_win,
+            )
+
+            mode = best[0][len("dequant_"):]
+            path = record_win(
+                "*", "*", "decode", mode,
+                source=f"scripts/kernel_sweep.py ({best[1]['value']} tok/s)",
+            )
+            print(f"TABLE: decode -> {mode} recorded in {path}")
 
 
 if __name__ == "__main__":

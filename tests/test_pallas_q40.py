@@ -312,3 +312,266 @@ def test_bf16_w_dtype_greedy_stream_model_scale(tiny_model):
         f"bf16-dot greedy stream diverged from exact f32: "
         f"{toks_bf16} vs {toks_f32}"
     )
+
+
+# ---------------------------------------------------------------------------
+# Shared Q80 activation operands (Q80Acts): one build per distinct input,
+# every matmul sharing it consumes the prebuilt layouts.
+# ---------------------------------------------------------------------------
+
+from distributed_llama_multiusers_tpu.ops.pallas_q40 import (  # noqa: E402
+    BLOCKDOT_MAX_M,
+    DEQUANT_MODES,
+    TRACE_STATS,
+    make_q80_acts,
+    reset_trace_stats,
+    set_dequant_mode,
+)
+
+
+@pytest.mark.parametrize("mode", ["v4", "blockdot", "i8blockdot"])
+def test_q80_acts_shared_vs_raw_parity(mode):
+    """A prebuilt Q80Acts bundle and a raw activation run the SAME traced
+    math per mode — only XLA fusion boundaries differ between the eager
+    build and the in-jit build, so i8blockdot (the one mode with a
+    reduction in operand prep) sits at ~1e-7 reduction-order wiggle.
+    Covers the two acts-consuming modes plus the v4 chain standing in for
+    the bf16-chain family (all chains unwrap the bundle via _raw_x on the
+    same line, so one representative pins the passthrough)."""
+    rng = np.random.default_rng(5)
+    pw = _pack(rng, 256, 128)
+    x = jnp.asarray(rng.standard_normal((4, 128), dtype=np.float32))
+    set_dequant_mode(mode)
+    try:
+        raw = np.asarray(
+            q40_matmul_pallas(x, pw, interpret=True, w_dtype=jnp.bfloat16)
+        )
+        acts = make_q80_acts(x)
+        assert make_q80_acts(acts) is acts  # idempotent
+        shared = np.asarray(
+            q40_matmul_pallas(acts, pw, interpret=True, w_dtype=jnp.bfloat16)
+        )
+    finally:
+        set_dequant_mode(None)
+    np.testing.assert_allclose(shared, raw, rtol=1e-5, atol=1e-5)
+
+
+def test_q80_acts_build_and_consume_counters():
+    """Trace-time counters witness the sharing: one shared build feeds N
+    consumes with zero per-site rebuilds."""
+    rng = np.random.default_rng(6)
+    weights = [_pack(rng, d_out, 128) for d_out in (128, 256, 384)]
+    x = jnp.asarray(rng.standard_normal((4, 128), dtype=np.float32))
+    reset_trace_stats()
+    acts = make_q80_acts(x, shared=True)
+    for pw in weights:
+        q40_matmul_pallas(acts, pw, interpret=True)
+    assert TRACE_STATS["acts_builds"] == 1, TRACE_STATS
+    assert TRACE_STATS["shared_builds"] == 1, TRACE_STATS
+    assert TRACE_STATS["shared_consumes"] == 3, TRACE_STATS
+
+
+def test_shared_acts_build_counts_model_scale(tiny_model):
+    """THE operand-sharing win at model scale: one llama_forward trace
+    builds exactly TWO shared bundles (the normed x for wq/wk/wv; the
+    FFN input for w1/w3) consumed at five matmul sites — the layer body
+    traces once under lax.scan. The remaining builds are the unshared
+    single-consumer sites (wo, w2 in the layer, wcls at the head)."""
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.models import init_kv_cache, llama_forward
+    from distributed_llama_multiusers_tpu.models.loader import (
+        load_params_from_m_quantized,
+    )
+    from distributed_llama_multiusers_tpu.ops import linear
+
+    h = load_model_header(tiny_model["model"])
+    config, qparams = load_params_from_m_quantized(
+        tiny_model["model"], h, dtype=jnp.float32
+    )
+    tokens = jnp.asarray([[3, 9, 27]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2]], jnp.int32)
+    linear.set_pallas_interpret(True)
+    try:
+        reset_trace_stats()
+        llama_forward(
+            config, qparams, tokens, positions, init_kv_cache(config, 1)
+        )
+        assert TRACE_STATS["shared_builds"] == 2, TRACE_STATS
+        assert TRACE_STATS["shared_consumes"] == 5, TRACE_STATS
+        # the only other builds come from the three unshared sites, each
+        # at most once per kernel-family trace (0 on a warm jit cache) —
+        # never one-per-consumer like the pre-sharing layout
+        assert TRACE_STATS["acts_builds"] - 2 <= 3, TRACE_STATS
+    finally:
+        linear.set_pallas_interpret(False)
+
+
+def test_blockdot_max_m_cap_routes_and_caches():
+    """BLOCKDOT_MAX_M boundary (documented in PERF.md): m at/under the cap
+    runs the selected blockdot-family mode, one past it falls back to
+    bf16chain — observed via the impl's resolved mode argument — and
+    repeated same-shape calls never re-trace the kernel core."""
+    from distributed_llama_multiusers_tpu.ops import pallas_q40 as pq
+
+    rng = np.random.default_rng(11)
+    pw = _pack(rng, 128, 64)
+    seen = []
+    real_impl = pq._q40_matmul_pallas_impl
+
+    def spy(x_, w_, interpret_, w_dtype_, mode_):
+        seen.append(mode_)
+        return real_impl(x_, w_, interpret_, w_dtype_, mode_)
+
+    pq._q40_matmul_pallas_impl = spy
+    try:
+        for mode in ("blockdot", "i8blockdot"):
+            set_dequant_mode(mode)
+            for m, expect in [
+                (BLOCKDOT_MAX_M - 1, mode),
+                (BLOCKDOT_MAX_M, mode),
+                (BLOCKDOT_MAX_M + 1, "bf16chain"),
+            ]:
+                seen.clear()
+                x = jnp.asarray(
+                    rng.standard_normal((m, 64), dtype=np.float32)
+                )
+                q40_matmul_pallas(x, pw, interpret=True, w_dtype=jnp.bfloat16)
+                assert seen == [expect], (mode, m, seen)
+        # auto resolves through the same boundary: the table's decode
+        # class IS the blockdot cap, so the m-class flip and the kernel
+        # fallback agree at m = BLOCKDOT_MAX_M + 1
+        set_dequant_mode("auto")
+        for m, expect in [
+            (BLOCKDOT_MAX_M, "i8blockdot"),
+            (BLOCKDOT_MAX_M + 1, "bf16chain"),
+        ]:
+            seen.clear()
+            x = jnp.asarray(rng.standard_normal((m, 64), dtype=np.float32))
+            q40_matmul_pallas(x, pw, interpret=True, w_dtype=jnp.bfloat16)
+            assert seen == [expect], ("auto", m, seen)
+        # no recompile churn: the second same-shape call is a jit cache
+        # hit — the kernel core's python body does not run again
+        set_dequant_mode("i8blockdot")
+        x = jnp.asarray(
+            rng.standard_normal((BLOCKDOT_MAX_M, 64), dtype=np.float32)
+        )
+        q40_matmul_pallas(x, pw, interpret=True, w_dtype=jnp.bfloat16)
+        traces = TRACE_STATS["impl_traces"]
+        q40_matmul_pallas(x, pw, interpret=True, w_dtype=jnp.bfloat16)
+        assert TRACE_STATS["impl_traces"] == traces, TRACE_STATS
+    finally:
+        pq._q40_matmul_pallas_impl = real_impl
+        set_dequant_mode(None)
+
+
+# ---------------------------------------------------------------------------
+# Q80xQ40 numerics pinning (make kernelcheck runs this grid standalone):
+# interpret-mode i8blockdot vs the exact f32 chain across shapes.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "d_in,d_out,m",
+    [
+        # the (d_in, d_out) axis at the m extremes of the decode class,
+        # plus the multi-chunk plane at the blockdot cap — the interpret
+        # kernel is slow enough that tier-1 keeps the informative corners
+        # and `make kernelcheck` + the slow stream pin carry the rest
+        (128, 256, 1), (128, 256, 8), (128, 256, 32),
+        (512, 256, 1),
+        (512, 1024, 32),
+    ],
+)
+def test_i8blockdot_parity_grid(d_in, d_out, m):
+    rng = np.random.default_rng(d_in * 7 + d_out + m)
+    pw = _pack(rng, d_out, d_in)
+    x = jnp.asarray(rng.standard_normal((m, d_in), dtype=np.float32))
+    exact = np.asarray(q40_matmul_pallas(x, pw, interpret=True))
+    set_dequant_mode("i8blockdot")
+    try:
+        got = np.asarray(
+            q40_matmul_pallas(x, pw, interpret=True, w_dtype=jnp.bfloat16)
+        )
+    finally:
+        set_dequant_mode(None)
+    rel = np.abs(got - exact).max() / (np.abs(exact).max() + 1e-9)
+    assert rel <= 2e-2, f"({d_in}x{d_out}, m={m}): max-rel {rel:.3e}"
+
+
+@pytest.mark.slow
+def test_i8blockdot_greedy_stream_token_identity(tmp_path):
+    """Decode-stream half of the numerics pin: >= 256 greedy tokens under
+    the shipping bf16 dot are token-identical between the i8blockdot
+    chain and the v4 chain on a seeded synthetic model, with bounded
+    prefill-logit drift."""
+    from distributed_llama_multiusers_tpu.formats.model_file import load_model_header
+    from distributed_llama_multiusers_tpu.formats.synthetic import (
+        tiny_header,
+        write_synthetic_model,
+    )
+    from distributed_llama_multiusers_tpu.models.loader import (
+        load_params_from_m_quantized,
+    )
+    from distributed_llama_multiusers_tpu.ops import linear
+    from distributed_llama_multiusers_tpu.runtime import InferenceEngine
+    from distributed_llama_multiusers_tpu.utils.testing import greedy_rollout
+
+    path = str(tmp_path / "stream.m")
+    write_synthetic_model(path, tiny_header(seq_len=320), seed=23)
+    h = load_model_header(path)
+    config, qparams = load_params_from_m_quantized(path, h, dtype=jnp.float32)
+    prompt = [5, 9, 3, 17, 2]
+
+    def rollout(mode):
+        linear.set_pallas_interpret(True)
+        linear.set_pallas_w_dtype(jnp.bfloat16)
+        set_dequant_mode(mode)
+        try:
+            engine = InferenceEngine(
+                config, qparams, n_lanes=1, prefill_buckets=(8,)
+            )
+            toks, _ = greedy_rollout(engine, prompt, 256)
+            logits, _, _ = engine.prefill(0, prompt)
+            return toks, np.asarray(logits)
+        finally:
+            set_dequant_mode(None)
+            linear.set_pallas_w_dtype(None)
+            linear.set_pallas_interpret(False)
+
+    toks_i8, logits_i8 = rollout("i8blockdot")
+    toks_v4, logits_v4 = rollout("v4")
+    assert len(toks_i8) >= 256
+    np.testing.assert_allclose(logits_i8, logits_v4, rtol=2e-2, atol=2e-2)
+    assert toks_i8 == toks_v4, (
+        f"i8blockdot greedy stream diverged from the v4 chain at "
+        f"position {next(i for i, (a, b) in enumerate(zip(toks_i8, toks_v4)) if a != b)}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mode-knob validation (set_dequant_mode / DLLAMA_DEQUANT fail loudly).
+# ---------------------------------------------------------------------------
+
+
+def test_set_dequant_mode_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown dequant mode"):
+        set_dequant_mode("q31wizard")
+    # the knob is unchanged after the rejection
+    from distributed_llama_multiusers_tpu.ops.pallas_q40 import DEQUANT_MODE
+
+    assert DEQUANT_MODE in DEQUANT_MODES + ("auto",)
+
+
+def test_env_dequant_rejects_unknown_on_import():
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, DLLAMA_DEQUANT="q31wizard", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import distributed_llama_multiusers_tpu.ops.pallas_q40"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "not a known dequant mode" in proc.stderr
