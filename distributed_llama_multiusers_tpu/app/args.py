@@ -126,6 +126,17 @@ def build_parser(prog: str, api: bool = False) -> argparse.ArgumentParser:
                         "sessions rebuild deterministically from the "
                         "request journal on next activity); 0 disables "
                         "parking; default: pool default (64)")
+    p.add_argument("--kv-host-bytes", type=int, default=None,
+                   help="--paged-kv on: host-RAM byte budget for the KV "
+                        "swap tier (runtime/kvpool.py HostTier). Parked "
+                        "pages evicted under pool pressure swap their "
+                        "bytes to host RAM (sha256-framed, LRU within "
+                        "the budget) instead of dropping; a later "
+                        "admission that misses HBM but hits the host "
+                        "tier swaps pages back in — cheaper than a "
+                        "journal rebuild, dearer than resident reuse. "
+                        "0 (default) disables the tier and restores "
+                        "drop-to-rebuild behavior bit-for-bit")
     # structured output (grammar/; docs/SERVING.md "Structured output")
     p.add_argument("--grammar", default="on", choices=["on", "off"],
                    help="serving: grammar-constrained decoding — requests "

@@ -238,6 +238,10 @@ def load_stack(args, n_lanes: int | None = None):
         kv_max_parked=(DEFAULT_MAX_PARKED
                        if getattr(args, "kv_max_parked", None) is None
                        else args.kv_max_parked),
+        # host-RAM swap tier budget (0 = disabled, drop-to-rebuild
+        # bit-for-bit); host-side only, so processes need not agree,
+        # but the OP_KV_SWAP replay assumes paged workers like pages
+        kv_host_bytes=getattr(args, "kv_host_bytes", None) or 0,
         # grammar slab capacity (structured output): every process must
         # agree — the slab arrays are compiled-program operands
         grammar_slab_states=getattr(args, "grammar_slab_states", None),
@@ -248,8 +252,12 @@ def load_stack(args, n_lanes: int | None = None):
             f"Paged KV: {engine.kvpool.n_pages} pages x "
             f"{engine.kvpool.page_size} tokens, "
             f"{engine.kvpool.blocks_per_lane} blocks/lane, "
-            f"max parked {engine.kvpool.max_parked} "
-            "(--paged-kv off restores contiguous planes)",
+            f"max parked {engine.kvpool.max_parked}, "
+            + (f"host swap tier "
+               f"{engine.kvpool.host_tier.budget_bytes // (1 << 20)} MiB"
+               if engine.kvpool.host_tier.enabled
+               else "host swap tier off")
+            + " (--paged-kv off restores contiguous planes)",
         )
     # structured output (grammar/; docs/SERVING.md "Structured output"):
     # register the tokenizer's piece table so response_format requests

@@ -163,6 +163,13 @@ def adopt_bundle(pool, engine, bundle: dict) -> dict:
     if not pairs:
         return {"pages": 0, "fresh": 0, "reused": 0}
     pages, fresh = pool.adopt([tokens for tokens, _ in pairs])
+    # adopt() may have evicted parked pages and staged them for the host
+    # swap tier: drain (device gather -> host store) BEFORE the payload
+    # imports below could reuse those pages. Duck-typed like the import
+    # hook — engines without a swap tier simply skip.
+    drain = getattr(engine, "drain_kv_swapouts", None)
+    if callable(drain):
+        drain()
     for idx, page in fresh:
         engine.import_kv_page(page, pairs[idx][1])
     return {

@@ -88,7 +88,15 @@ PACKET_MAGIC = 0x444C4C41  # "DLLA"
 # shard (wrong gathers, not a deadlock), the same silent-divergence
 # class v3 closed for table rows. The bump classifies it on the first
 # packet.
-PROTOCOL_VERSION = 5
+# v6: tiered KV residency — OP_KV_SWAP ships host-tier swap-in page
+# payloads (parked pages evicted to host RAM reactivating by copy,
+# runtime/kvpool.HostTier). The packet size did NOT change, so a v5
+# peer COULD frame a v6 broadcast and would replay every op except the
+# swap-ins — reactivated pages would read as stale/garbage KV on that
+# process's shard (wrong gathers, not a deadlock), the same
+# silent-divergence class v3/v5 closed. The bump classifies it on the
+# first packet.
+PROTOCOL_VERSION = 6
 
 OP_STOP = 0
 OP_PREFILL = 1
@@ -144,6 +152,17 @@ OP_KV_PAGES = 14  # disaggregated prefill (disagg/kvtransfer.py): import a
 # program the root runs, so the replicated pool arrays stay
 # byte-identical. Pool bookkeeping (adopt(), refcounts, prefix tree)
 # stays root-only HOST state, exactly like OP_KV_TABLE's split.
+OP_KV_SWAP = 15  # tiered KV residency (runtime/kvpool.HostTier): reactivate
+# host-swapped pages on every process. Framed like OP_KV_PAGES — `lane`
+# carries flags (bit 0: final fragment of this page's payload, bit 1:
+# final page of the BATCH), `n` the fragment byte length, `start_pos`
+# the destination page id; payload bytes ride slot 0 as packed int32
+# words. Workers accumulate fragments into (page, payload) pairs and on
+# the batch-final flag dispatch ONE engine.swap_in_pages — the same
+# warmed batched scatter program the root runs, so the replicated pool
+# arrays stay byte-identical and the per-batch dispatch count matches.
+# Swap-OUT never rides the wire: it is a root-local device READ (the
+# host tier, like all pool bookkeeping, is root-only HOST state).
 
 
 class ReplayError(RuntimeError):
@@ -516,6 +535,43 @@ class ControlPlane:
                     np.int32
                 )
                 self._send(OP_KV_PAGES, flags, len(frag), int(page), words)
+
+    def send_kv_swap(self, pages) -> None:
+        """Broadcast a host-tier swap-in BATCH (tiered KV residency):
+        each ``(page, payload_bytes)`` is chunked into packet-slot
+        fragments like ``send_kv_pages`` — flags in ``lane`` (bit 0:
+        final fragment of this page, bit 1: final page of the batch,
+        set on that page's final fragment), fragment byte length in
+        ``n``, the destination page id in ``start_pos``. The batch flag
+        lets workers dispatch ONE batched scatter per root dispatch
+        (engine.swap_in_pages), keeping program counts identical.
+        Raises pre-broadcast (the pod-deadlock rule) on an empty batch
+        or a negative page id — payload-size validation against the
+        pool geometry is the caller's job
+        (RootControlEngine.swap_in_pages)."""
+        if not pages:
+            raise ValueError("kv swap batch must not be empty")
+        frag_bytes = self.chunk * 4  # int32 words carry 4 payload bytes
+        for p, _ in pages:
+            if int(p) < 0:
+                raise ValueError(f"kv page id must be >= 0, got {p}")
+        for j, (page, payload) in enumerate(pages):
+            blob = bytes(payload)
+            frags = [
+                blob[off : off + frag_bytes]
+                for off in range(0, max(1, len(blob)), frag_bytes)
+            ]
+            for idx, frag in enumerate(frags):
+                flags = 0
+                if idx == len(frags) - 1:
+                    flags |= 1
+                    if j == len(pages) - 1:
+                        flags |= 2
+                pad = (-len(frag)) % 4
+                words = np.frombuffer(frag + b"\0" * pad, np.uint8).view(
+                    np.int32
+                )
+                self._send(OP_KV_SWAP, flags, len(frag), int(page), words)
 
     def recv(self) -> np.ndarray:
         faults.fire("plane.recv")  # chaos harness; no-op unarmed
@@ -954,10 +1010,18 @@ class RootControlEngine:
         admission shed) raises with no packet on the wire. Only the
         device half replays: the COW page copies and the new table row
         ride OP_KV_TABLE so every process's replicated table leaf (and
-        the compiled gathers through it) stay byte-identical."""
-        start, blocks, copies = self._engine.kvpool.admit(
+        the compiled gathers through it) stay byte-identical. Tiered
+        residency keeps the engine's ordering: staged swap-outs drain
+        root-locally (a device READ — nothing to replay), host-tier
+        hits broadcast as ONE OP_KV_SWAP batch, then the table/COW
+        packet follows."""
+        start, blocks, copies, swapins = self._engine.kvpool.admit(
             lane, list(tokens), reserve_tokens, min_share_tokens
         )
+        self._engine.drain_kv_swapouts()
+        if swapins:
+            self.swap_in_pages([p for p, _ in swapins],
+                               [b for _, b in swapins])
         self.apply_paged_admit(
             lane, self._engine._paged_table_row(blocks), copies
         )
@@ -968,8 +1032,11 @@ class RootControlEngine:
         and pre-broadcast, then the all-unmapped table row replays on
         every process — no packet at all when the lane never mapped
         anything (the exhaustion-shed reject path), matching the
-        single-process skip so workers stay in step."""
-        if self._engine.kvpool.finish(lane, park=park):
+        single-process skip so workers stay in step. LRU-overflow
+        swap-outs drain root-locally (a device read, no packet)."""
+        held = self._engine.kvpool.finish(lane, park=park)
+        self._engine.drain_kv_swapouts()
+        if held:
             self.apply_paged_admit(
                 lane, self._engine._paged_table_row([]), []
             )
@@ -1001,6 +1068,35 @@ class RootControlEngine:
         self._plane.send_kv_pages([(page, payload)])
         self._engine.import_kv_page(page, payload)
 
+    def swap_in_pages(self, pages, payloads) -> None:
+        """Host-tier swap-in on a pod: validate ROOT-side first — a
+        non-paged engine, a count mismatch or a geometry-skewed payload
+        must die with zero packets out (the pod-deadlock rule) — then
+        broadcast the whole batch (OP_KV_SWAP) so every process
+        dispatches the same warmed batched scatter program and the
+        sharded pool arrays stay byte-identical. warmup_engine reaches
+        this through the engine facade to pre-compile the programs on
+        every process."""
+        if getattr(self._engine, "kvpool", None) is None:
+            raise RuntimeError("swap_in_pages needs a paged engine")
+        if len(pages) != len(payloads):
+            raise ValueError(
+                f"swap_in_pages: {len(pages)} pages vs "
+                f"{len(payloads)} payloads"
+            )
+        if not pages:
+            return
+        shape, dtype = self._engine._page_leaf_geometry()
+        half = int(np.prod(shape)) * dtype.itemsize
+        for i, payload in enumerate(payloads):
+            if len(payload) != 2 * half:
+                raise ValueError(
+                    f"swap payload {i} is {len(payload)} bytes, expected "
+                    f"{2 * half} for page geometry {tuple(shape)} {dtype}"
+                )
+        self._plane.send_kv_swap(list(zip(pages, payloads)))
+        self._engine.swap_in_pages(pages, payloads)
+
 
 def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
     """Replay root-broadcast engine calls until OP_STOP — the SPMD twin of
@@ -1012,6 +1108,8 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
     packet — ``worker_serve`` uses it to refresh its restart budget."""
     gram_buf = bytearray()  # OP_GRAMMAR fragment accumulator
     page_buf = bytearray()  # OP_KV_PAGES fragment accumulator
+    swap_buf = bytearray()  # OP_KV_SWAP fragment accumulator (one page)
+    swap_batch: list = []  # OP_KV_SWAP completed (page, payload) pairs
     while True:
         pkt = plane.recv()
         # header: [magic, version, op, lane, n, start_pos] — magic/version
@@ -1246,6 +1344,40 @@ def worker_loop(engine, plane: ControlPlane, on_replay=None) -> None:
                     # row-width skew instead of burning a restart
                     raise ReplayError(
                         f"OP_KV_PAGES payload rejected: {e} — root and "
+                        "worker paged-KV geometry flags are skewed"
+                    ) from e
+        elif op == OP_KV_SWAP:
+            # host-tier swap-in replay: payload fragments accumulate per
+            # page (flag bit 0 = final fragment of this page), completed
+            # pages accumulate per batch (bit 1 = final page of the
+            # batch) — then ONE batched scatter dispatches, matching the
+            # root's program count dispatch-for-dispatch. A non-paged
+            # engine receiving this is a config skew — classified
+            # pre-dispatch, no collective was entered on it
+            if getattr(engine, "kvpool", None) is None:
+                raise ReplayError(
+                    "OP_KV_SWAP on a non-paged engine: root and worker "
+                    "--paged-kv flags are skewed"
+                )
+            frag = plane.slot(pkt, 0, (n + 3) // 4).view(np.uint8)[:n]
+            swap_buf += frag.tobytes()
+            if lane & 1:  # final fragment of this page's payload
+                swap_batch.append((start_pos, bytes(swap_buf)))
+                swap_buf = bytearray()
+            if lane & 2:  # final page of the batch: dispatch as one
+                batch = swap_batch
+                swap_batch = []
+                try:
+                    # dlint: ok[device-affinity] worker replay loop = this process's batching thread
+                    engine.swap_in_pages(
+                        [p for p, _ in batch], [b for _, b in batch]
+                    )
+                except ValueError as e:
+                    # geometry skew (root and worker disagree on the
+                    # page shape/dtype): classified like OP_KV_PAGES'
+                    # payload skew instead of burning a restart
+                    raise ReplayError(
+                        f"OP_KV_SWAP payload rejected: {e} — root and "
                         "worker paged-KV geometry flags are skewed"
                     ) from e
         else:

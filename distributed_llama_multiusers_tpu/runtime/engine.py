@@ -53,6 +53,13 @@ from .spec import SPEC_DRAFT
 
 DEFAULT_PREFILL_BUCKETS = (16, 64, 256, 1024)
 
+# host-swap transfer batch: pages moved per device dispatch by the
+# gather/scatter swap programs (fixed operand shape = ONE compile each;
+# short batches pad by repeating the first page — duplicate scatter
+# indices carrying identical values are deterministic, and the pool
+# axis has no sentinel page to park padding on)
+_SWAP_BATCH = 8
+
 # THE top-p default for every sampling surface (engine wrappers, scheduler
 # batch vectors, control-plane packet normalization, Request): one constant,
 # so a future default change cannot desync the compiled-step operands from
@@ -255,6 +262,7 @@ class InferenceEngine:
     _dlint_device_affine = (
         "apply_paged_admit", "copy_lane", "paged_unmap_all",
         "export_kv_page", "import_kv_page",
+        "swap_out_pages", "swap_in_pages",
     )
 
     def __init__(
@@ -273,6 +281,7 @@ class InferenceEngine:
         kv_page_size: int = DEFAULT_PAGE_SIZE,
         kv_pool_pages: int | None = None,
         kv_max_parked: int = DEFAULT_MAX_PARKED,
+        kv_host_bytes: int = 0,
         grammar_slab_states: int | None = None,
         grammar_slab_edges: int | None = None,
     ):
@@ -289,7 +298,9 @@ class InferenceEngine:
         ``kv_pool_pages`` sizes the pool (default: the contiguous
         layout's exact footprint, n_lanes x blocks-per-full-lane);
         ``kv_max_parked`` bounds parked sessions (LRU-evicted under pool
-        pressure)."""
+        pressure); ``kv_host_bytes`` budgets the host-RAM swap tier
+        between "parked" and "dropped" (0 disables it, restoring
+        drop-to-rebuild bit-for-bit — see ``kvpool.HostTier``)."""
         self.config = config
         self.params = params
         self.n_lanes = n_lanes
@@ -332,7 +343,16 @@ class InferenceEngine:
             self.kvpool = KVPagePool.for_seq_len(
                 config.seq_len, n_lanes, page_size=kv_page_size,
                 pool_pages=kv_pool_pages, max_parked=kv_max_parked,
+                host_bytes=kv_host_bytes,
             )
+            # swap-tier traffic counters: single-writer (every swap op
+            # runs on the scheduler loop thread / device-op funnel),
+            # read lock-free by pool_stats() from HTTP threads
+            self.swap_ins = 0
+            self.swap_outs = 0
+            self.swap_in_bytes = 0
+            self.swap_out_bytes = 0
+            self.swap_in_ms = 0.0
             bs = self.kvpool.page_size
             n_pages = self.kvpool.n_pages
             # dlint: ok[host-sync] host int lists -> the numpy table mirror; no device value involved
@@ -1065,6 +1085,34 @@ class InferenceEngine:
             )
 
         self._write_page_fn = _write_page
+
+        @jax.jit
+        def _gather_pages(cache, idx):
+            # batched page READ for host swap-out: NOT donated — the
+            # cache stays the live serving pytree, and dispatch order
+            # (this read before any later-dispatched donated write)
+            # guarantees the gathered bytes are the pre-eviction content
+            # even when the pages are already re-popped for the same
+            # admission. Fixed [_SWAP_BATCH] idx operand: ONE compile.
+            return cache.k[:, idx], cache.v[:, idx]
+
+        self._gather_pages_fn = _gather_pages
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _scatter_pages(cache, idx, k_pages, v_pages):
+            # batched page WRITE for host swap-in — the donated cache
+            # pytree orders it before any later-dispatched tail
+            # prefill/decode, exactly like a COW copy, and the fixed
+            # [_SWAP_BATCH] operand shapes mean ONE compile for any
+            # destination set (padding repeats a real page with its own
+            # content — an idempotent duplicate write)
+            return PagedKVCache(
+                k=cache.k.at[:, idx].set(k_pages),
+                v=cache.v.at[:, idx].set(v_pages),
+                table=cache.table,
+            )
+
+        self._scatter_pages_fn = _scatter_pages
 
         def _make_decode_multi(h):
             @partial(jax.jit, donate_argnums=(1,))
@@ -2162,10 +2210,24 @@ class InferenceEngine:
         plus at most one single-page COW at the divergent block); the
         caller prefills only ``tokens[start:]``. Raises
         :class:`~.kvpool.PoolExhausted` when the pool cannot serve the
-        reservation even after evicting parked sessions."""
-        start, blocks, copies = self.kvpool.admit(
+        reservation even after evicting parked sessions.
+
+        Tiered residency ordering: (1) the pool admission may evict
+        parked pages and stage them for swap-out; (2) those stage
+        entries DRAIN (device gather -> host tier) before anything
+        writes — the gather dispatches first, so it reads pre-eviction
+        bytes even when an evicted page was immediately re-popped as
+        this admission's fresh page; (3) host-tier hits scatter back in
+        (``swapins``); (4) COW copies + the table row apply. All four
+        thread the donated cache pytree, so the tail prefill can never
+        observe a half-applied admission."""
+        start, blocks, copies, swapins = self.kvpool.admit(
             lane, tokens, reserve_tokens, min_share_tokens
         )
+        self.drain_kv_swapouts()
+        if swapins:
+            self.swap_in_pages([p for p, _ in swapins],
+                               [b for _, b in swapins])
         self.apply_paged_admit(lane, self._paged_table_row(blocks), copies)
         return start
 
@@ -2181,8 +2243,12 @@ class InferenceEngine:
         ``park=False`` frees everything (failure path). The lane's table
         row resets to all-unmapped — skipped entirely when the lane never
         mapped anything (the exhaustion-shed reject path), so overload
-        rejects stay host-only cheap."""
-        if self.kvpool.finish(lane, park=park):
+        rejects stay host-only cheap. Parking may overflow the LRU bound
+        and stage swap-outs — drained here, before the unmap's table
+        write could be followed by page-reusing dispatches."""
+        held = self.kvpool.finish(lane, park=park)
+        self.drain_kv_swapouts()
+        if held:
             self.apply_paged_admit(lane, self._paged_table_row([]), [])
 
     def paged_unmap_all(self) -> None:
@@ -2202,8 +2268,20 @@ class InferenceEngine:
 
     def pool_stats(self) -> dict:
         """Page-pool pressure snapshot for /stats (bridged to /metrics);
-        ``{}`` on contiguous engines."""
-        return self.kvpool.stats() if self.kvpool is not None else {}
+        ``{}`` on contiguous engines. Merges the engine's swap-traffic
+        counters next to the pool's host-tier gauges so the whole tier
+        story reads off one surface."""
+        if self.kvpool is None:
+            return {}
+        out = self.kvpool.stats()
+        out.update({
+            "swap_ins": self.swap_ins,
+            "swap_outs": self.swap_outs,
+            "swap_in_bytes": self.swap_in_bytes,
+            "swap_out_bytes": self.swap_out_bytes,
+            "swap_in_ms": round(self.swap_in_ms, 3),
+        })
+        return out
 
     def _page_leaf_geometry(self) -> tuple[tuple, "np.dtype"]:
         """One page's K (or V) leaf shape/dtype: ``[L, page_size,
@@ -2246,6 +2324,138 @@ class InferenceEngine:
         self.cache = self._write_page_fn(
             self.cache, jnp.int32(page), k_page, v_page
         )
+
+    # -- tiered KV residency: the device halves of the host swap tier -------
+
+    def swap_out_pages(self, pages) -> list:
+        """Batched device->host read of physical pages' K/V bytes for the
+        swap tier — ``export_kv_page``'s encoding (K then V, raw
+        row-major per page) at ``_SWAP_BATCH`` pages per dispatch, so
+        swapping a whole evicted chain costs ceil(n/_SWAP_BATCH) device
+        programs instead of n. A host sync by design, like the disagg
+        export: the pages just LEFT the pool (or are committed and
+        immutable), so the bytes are stable."""
+        if self.kvpool is None:
+            raise RuntimeError("swap_out_pages needs a paged engine")
+        out: list = []
+        for off in range(0, len(pages), _SWAP_BATCH):
+            chunk = [int(p) for p in pages[off: off + _SWAP_BATCH]]  # dlint: ok[host-sync] page ids are host ints from the pool, never device values
+            n = len(chunk)
+            # dlint: ok[host-sync] host int list -> fixed-shape index operand; no device value involved
+            idx = np.asarray(
+                (chunk + [chunk[0]] * _SWAP_BATCH)[:_SWAP_BATCH], np.int32
+            )
+            k_g, v_g = self._gather_pages_fn(self.cache, idx)
+            # dlint: ok[host-sync] sanctioned swap-out choke point: evicted committed pages' K/V leave the device here
+            k_h = np.asarray(k_g)
+            # dlint: ok[host-sync] second half of the same sanctioned swap-out gather
+            v_h = np.asarray(v_g)
+            for i in range(n):
+                out.append(k_h[:, i].tobytes() + v_h[:, i].tobytes())
+        return out
+
+    def swap_in_pages(self, pages, payloads) -> None:
+        """Batched host->device write reactivating swapped pages (the
+        inverse of :meth:`swap_out_pages`): every payload is
+        size-validated against the page-leaf geometry BEFORE anything
+        dispatches (a geometry-skewed payload must not half-apply), then
+        the chunked scatter threads the donated cache pytree — ordered
+        before any later-dispatched tail prefill, exactly like a COW
+        copy. Raises ``ValueError`` on a size or count mismatch."""
+        if self.kvpool is None:
+            raise RuntimeError("swap_in_pages needs a paged engine")
+        if len(pages) != len(payloads):
+            raise ValueError(
+                f"swap_in_pages: {len(pages)} pages vs "
+                f"{len(payloads)} payloads"
+            )
+        if not pages:
+            return
+        shape, dtype = self._page_leaf_geometry()
+        half = int(np.prod(shape)) * dtype.itemsize
+        for i, payload in enumerate(payloads):
+            if len(payload) != 2 * half:
+                raise ValueError(
+                    f"swap_in_pages: payload {i} is {len(payload)} bytes, "
+                    f"expected {2 * half} for page geometry "
+                    f"{tuple(shape)} {dtype}"
+                )
+        t0 = time.perf_counter()
+        for off in range(0, len(pages), _SWAP_BATCH):
+            chunk_p = [int(p) for p in pages[off: off + _SWAP_BATCH]]  # dlint: ok[host-sync] page ids are host ints from the pool, never device values
+            chunk_b = list(payloads[off: off + _SWAP_BATCH])
+            while len(chunk_p) < _SWAP_BATCH:  # idempotent duplicate pad
+                chunk_p.append(chunk_p[0])
+                chunk_b.append(chunk_b[0])
+            idx = np.asarray(chunk_p, np.int32)  # dlint: ok[host-sync] host int list -> index operand; no device value involved
+            k_stack = np.stack(
+                [np.frombuffer(b[:half], dtype=dtype).reshape(shape)
+                 for b in chunk_b], axis=1,
+            )
+            v_stack = np.stack(
+                [np.frombuffer(b[half:], dtype=dtype).reshape(shape)
+                 for b in chunk_b], axis=1,
+            )
+            self.cache = self._scatter_pages_fn(
+                self.cache, idx, k_stack, v_stack
+            )
+        self.swap_ins += len(pages)
+        self.swap_in_bytes += sum(len(b) for b in payloads)
+        self.swap_in_ms += (time.perf_counter() - t0) * 1000.0
+
+    def drain_kv_swapouts(self) -> int:
+        """Move the pool's staged swap-outs into the host tier: take the
+        pending ``(node_key, block, page)`` triples, read the pages in
+        batched device gathers, and store each payload under its chain
+        key. Runs inside every paged mutation point (admit/finish/
+        swap_out_parked) BEFORE any device write that could reuse the
+        freed pages. Best-effort cache with strict accounting: a failed
+        device read discards the batch (the tier just misses — the
+        sessions rebuild from the journal as before) and re-raises for
+        engine-scoped containment; an over-budget ``put`` simply drops.
+        Returns how many pages the tier actually stored."""
+        if self.kvpool is None:
+            return 0
+        tier = self.kvpool.host_tier
+        if not tier.enabled:
+            return 0
+        pending = self.kvpool.take_pending_swapouts()
+        if not pending:
+            return 0
+        try:
+            payloads = self.swap_out_pages([p for _, _, p in pending])
+        except BaseException:
+            for node_key, _blk, _page in pending:
+                tier.discard(node_key)
+            raise
+        stored = 0
+        for (node_key, blk, _page), payload in zip(pending, payloads):
+            if tier.put(node_key, blk, payload):
+                stored += 1
+        self.swap_outs += len(pending)
+        self.swap_out_bytes += sum(len(b) for b in payloads)
+        return stored
+
+    def swap_out_parked(self) -> int:
+        """Evict every parked session straight into the host tier (the
+        bench/test lever for the middle residency tier; pressure
+        eviction takes the same path organically). Returns how many
+        sessions were evicted."""
+        if self.kvpool is None:
+            return 0
+        n = self.kvpool.swap_out_parked()
+        self.drain_kv_swapouts()
+        return n
+
+    def reset_swap_stats(self) -> None:
+        """Zero the swap-traffic counters (warmup drops its own warm
+        dispatch from them, like reset_worker_stats for pod counters —
+        a METHOD so pod proxies reach the owning engine's attributes)."""
+        self.swap_ins = 0
+        self.swap_outs = 0
+        self.swap_in_bytes = 0
+        self.swap_out_bytes = 0
+        self.swap_in_ms = 0.0
 
     def reset_lane(self, lane: int) -> None:
         """Nothing to clear on device: a fresh request's prefill rewrites the
@@ -2394,6 +2604,20 @@ def warmup_engine(
                 # program (pod roots broadcast via the RootControlEngine
                 # override so workers compile too).
                 imp(0, exp(0))
+            swap_out = getattr(engine, "swap_out_pages", None)
+            swap_in = getattr(engine, "swap_in_pages", None)
+            if callable(swap_out) and callable(swap_in):
+                # the batched swap gather/scatter programs (host tier):
+                # the first pressure eviction / host-tier reactivation
+                # must not eat an XLA compile mid-service. Page 0's own
+                # zeros ride out and back through the real programs —
+                # batch padding makes this the same compiled shape as
+                # any real batch (pod roots broadcast the swap-in via
+                # the RootControlEngine override so workers compile too).
+                swap_in([0], swap_out([0]))
+                reset_swap = getattr(engine, "reset_swap_stats", None)
+                if callable(reset_swap):
+                    reset_swap()
         if pool is None and n > 1:
             # the contiguous prefix-reuse primitive (found by dlint's
             # warmup-coverage at adoption): the first shared-prefix

@@ -50,6 +50,24 @@ writes covered the read frontier, and shared pages only expose content
 below the committing session's watermark — the same
 overwrite-before-readable invariant the contiguous path relies on.
 
+- **Tiered residency** — between "parked in HBM" (reactivates free) and
+  "dropped" (reactivates by re-prefill) sits :class:`HostTier`: a
+  bounded (``--kv-host-bytes``, LRU) host-RAM store of swapped page
+  payloads keyed by the SAME ``(parent_key, block)`` content-hash chain
+  as the prefix tree, so a swapped prefix is still shared — one host
+  copy serves every future admission of that chain. Pressure eviction
+  deposits each freed committed page into ``_pending_swapouts``; the
+  ENGINE drains those (``take_pending_swapouts`` -> device read ->
+  ``HostTier.put``) before dispatching any write that could reuse the
+  page, and an admission that misses HBM but hits the host tier gets its
+  payloads back as ``swapins`` — fresh pages that reactivate with a
+  host->device copy instead of a re-prefill. Integrity rides
+  :func:`~..disagg.kvtransfer.page_hash` (one serializer with the
+  disagg transfer path, no drift): a mismatch on swap-in raises
+  :class:`HostTierCorrupt` (request-scoped, entry dropped, prefix tree
+  untouched) and the retry re-prefills. ``--kv-host-bytes 0`` disables
+  the tier and restores drop-to-rebuild bit-for-bit.
+
 Pure host/stdlib (no jax): the device half (pool arrays, page tables,
 the page-copy program) lives in :mod:`runtime.engine`; the scheduler-
 level oversubscription tests run this class under MockAsyncEngine
@@ -61,6 +79,7 @@ from __future__ import annotations
 from collections import OrderedDict
 from itertools import islice
 
+from ..disagg.kvtransfer import page_hash
 from ..lockcheck import make_lock
 
 # root key of the prefix tree; node keys are (parent_key, block_tokens)
@@ -84,10 +103,16 @@ class PoolExhausted(RuntimeError):
     scheduler maps this to a typed retryable shed (HTTP 429), never a
     500."""
 
-    def __init__(self, need: int, free: int, total: int):
+    def __init__(self, need: int, free: int, total: int,
+                 host_tier_full: bool = False):
         self.pages_needed = need
         self.pages_free = free
         self.pages_total = total
+        # whether the host swap tier was enabled AND at budget when the
+        # shed fired: the scheduler sheds "host_tier_full" instead of
+        # "pool_exhausted" so dashboards can tell "raise --kv-host-bytes"
+        # apart from "raise --kv-pool-pages"
+        self.host_tier_full = host_tier_full
         super().__init__(
             f"kv page pool exhausted: admission needs {need} pages, "
             f"{free}/{total} free and parked-session eviction cannot "
@@ -95,9 +120,187 @@ class PoolExhausted(RuntimeError):
         )
 
 
+class HostTierCorrupt(ValueError):
+    """A swapped page's payload failed its integrity re-hash on the way
+    back in. ValueError family on purpose: the scheduler treats it as a
+    request-scoped failure (HTTP 4xx/typed stream error, breaker stays
+    closed) — the corrupt entry is dropped from the tier before raising,
+    the prefix tree was never touched, and the request's retry misses
+    the tier and re-prefills deterministically from the prompt."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            "host-tier kv page failed integrity verification"
+            + (f": {detail}" if detail else "")
+        )
+
+
 def blocks_for(n_tokens: int, page_size: int) -> int:
     """Pages needed to hold ``n_tokens`` KV slots."""
     return max(0, -(-int(n_tokens) // int(page_size)))
+
+
+class HostTier:
+    """Bounded host-RAM store of swapped KV page payloads — the middle
+    residency tier between "parked in HBM" and "dropped".
+
+    Entries are keyed by the prefix tree's node key (the
+    ``(parent_key, block)`` content-hash chain), so the tier IS a
+    shadow of the tree for pages the pool had to free: one host copy
+    serves every future admission that walks the same chain, exactly
+    like a resident parked page serves N sharers. The byte budget is
+    LRU-enforced at ``put``; a hit refreshes recency and does NOT
+    remove the entry (shared by design — removal happens only by LRU
+    pressure, :meth:`discard`, :meth:`clear`, or a failed integrity
+    re-hash). Every payload is hashed at ``put`` and re-verified at
+    ``get`` with :func:`~..disagg.kvtransfer.page_hash` — the same
+    canonical framing the disagg transfer bundles use, so the two
+    serializers cannot drift.
+
+    Own lock (``HostTier._lock``): the engine's drain runs device reads
+    between ``put`` calls, and /stats reads the gauges from HTTP
+    threads; the pool may call in while holding ``KVPagePool._lock``
+    (pool -> tier is the one sanctioned nesting order — the tier never
+    calls back into the pool)."""
+
+    # dlint guarded-by declaration (analysis/lock_check.py): all tier
+    # state may only be touched holding `_lock`
+    _dlint_guarded_by = {
+        ("_lock",): (
+            "_swapped", "_bytes",
+            "hits", "misses", "evicted", "full_drops", "corrupt_drops",
+            "stored",
+        ),
+    }
+
+    # dlint resource-lifecycle declaration (analysis/resourcemodel.py):
+    # the release half of the host-page kind — pending swap-outs the
+    # engine took from the pool (``take_pending_swapouts`` acquires)
+    # must each land in ``put`` (stored) or ``discard`` (dropped:
+    # device read failed, tier disabled mid-flight, containment).
+    _dlint_releases = {"host-page": ("put", "discard")}
+
+    def __init__(self, budget_bytes: int, page_size: int):
+        self.budget_bytes = max(0, int(budget_bytes))
+        self.page_size = int(page_size)
+        self._lock = make_lock("HostTier._lock")
+        # node key -> (payload bytes, integrity hash); OrderedDict order
+        # IS the LRU (oldest first)
+        self._swapped: "OrderedDict[tuple, tuple[bytes, str]]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evicted = 0
+        self.full_drops = 0  # payloads refused at put (oversize/disabled)
+        self.corrupt_drops = 0  # entries dropped by a failed re-hash
+        self.stored = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_bytes > 0
+
+    def full(self) -> bool:
+        """Whether the tier is at (or over) its byte budget — the
+        host-tier-full half of the shed-reason distinction."""
+        with self._lock:
+            return self.enabled and self._bytes >= self.budget_bytes
+
+    def put(self, node_key: tuple, blk_tokens, payload: bytes) -> bool:
+        """Store one swapped page's payload under its tree node key.
+        Hashes the payload (the exporter-side half of the integrity
+        frame), refreshes recency on a re-put of a known key, and
+        LRU-evicts until the byte budget holds. Returns whether the
+        payload is resident after the call — ``False`` means dropped
+        (tier disabled, or the payload alone exceeds the budget)."""
+        blk = tuple(int(t) for t in blk_tokens)
+        data = bytes(payload)
+        h = page_hash(self.page_size, blk, data)
+        with self._lock:
+            if not self.enabled or len(data) > self.budget_bytes:
+                self.full_drops += 1
+                return False
+            prior = self._swapped.pop(node_key, None)
+            if prior is not None:
+                self._bytes -= len(prior[0])
+            while self._swapped and self._bytes + len(data) > self.budget_bytes:
+                _, (old, _h) = self._swapped.popitem(last=False)
+                self._bytes -= len(old)
+                self.evicted += 1
+            self._swapped[node_key] = (data, h)
+            self._bytes += len(data)
+            self.stored += 1
+            return True
+
+    def get(self, node_key: tuple, blk_tokens) -> bytes | None:
+        """Look up a swapped page by tree node key. A hit re-verifies
+        the payload against its stored hash and refreshes LRU recency
+        (the entry STAYS — one host copy serves N admissions); a failed
+        re-hash drops the entry and raises :class:`HostTierCorrupt`
+        (request-scoped — the caller has not mutated anything yet)."""
+        blk = tuple(int(t) for t in blk_tokens)
+        with self._lock:
+            entry = self._swapped.get(node_key)
+            if entry is None:
+                self.misses += 1
+                return None
+            data, want = entry
+            if page_hash(self.page_size, blk, data) != want:
+                del self._swapped[node_key]
+                self._bytes -= len(data)
+                self.corrupt_drops += 1
+                raise HostTierCorrupt(
+                    f"node at depth {_key_depth(node_key)} "
+                    f"({len(data)} bytes) — entry dropped, request "
+                    "retry will re-prefill"
+                )
+            self._swapped.move_to_end(node_key)
+            self.hits += 1
+            return data
+
+    def discard(self, node_key: tuple) -> None:
+        """Drop an entry if present (idempotent) — the release path for
+        a pending swap-out whose device read failed, and the disposal
+        half of containment."""
+        with self._lock:
+            entry = self._swapped.pop(node_key, None)
+            if entry is not None:
+                self._bytes -= len(entry[0])
+
+    def clear(self) -> int:
+        """Drop every entry (containment / the bench's rebuild lever —
+        without this, drop_parked would still reactivate via the tier).
+        Returns how many entries were dropped."""
+        with self._lock:
+            n = len(self._swapped)
+            self._swapped.clear()
+            self._bytes = 0
+            return n
+
+    def stats(self) -> dict:
+        """Tier pressure snapshot (one lock hold); merged into the
+        pool's ``stats()`` so every field rides the /stats -> /metrics
+        bridge as a ``dllama_stats_pool_*`` gauge."""
+        with self._lock:
+            return {
+                "pool_host_pages": len(self._swapped),
+                "pool_host_bytes": self._bytes,
+                "pool_host_budget_bytes": self.budget_bytes,
+                "pool_host_hits": self.hits,
+                "pool_host_misses": self.misses,
+                "pool_host_evicted": self.evicted,
+                "pool_host_full_drops": self.full_drops,
+                "pool_host_corrupt": self.corrupt_drops,
+                "pool_host_stored": self.stored,
+            }
+
+
+def _key_depth(key: tuple) -> int:
+    """Chain depth of a prefix-tree node key (diagnostics only)."""
+    d = 0
+    while key != _ROOT and isinstance(key, tuple) and len(key) == 2:
+        key = key[0]
+        d += 1
+    return d
 
 
 class KVPagePool:
@@ -117,11 +320,12 @@ class KVPagePool:
             "_free", "_ref", "_nodes", "_page_key", "_children",
             "_lane_blocks", "_lane_reg", "_lane_tip",
             "_parked", "_parked_pages", "_park_refs", "_park_seq",
-            "_park_index",
+            "_park_index", "_pending_swapouts",
             "admits", "prefix_admits", "prefix_tokens_shared",
             "cow_copies", "parked_evicted", "exhausted_sheds",
             "parked_total", "pool_resets",
             "adopts", "adopted_pages_fresh",
+            "swap_in_admits", "host_pages_swapped_in",
         ),
     }
 
@@ -130,8 +334,15 @@ class KVPagePool:
     # the caller; every exit path must reach ``finish`` (park or free),
     # ``release``/``drop_parked`` (park holds), or ``reset``. Checked by
     # resource-balance; witnessed at runtime via ``pool_pages_in_use``
-    # (analysis/leakcheck.py, DLLAMA_LEAKCHECK=1).
-    _dlint_acquires = {"kv-page": ("admit", "adopt")}
+    # (analysis/leakcheck.py, DLLAMA_LEAKCHECK=1). The host-page kind is
+    # the swap tier's half: ``take_pending_swapouts`` hands the engine
+    # the deposited (node_key, block, page) triples, and each must land
+    # in ``HostTier.put`` or ``HostTier.discard`` — witnessed at runtime
+    # via ``pool_swap_pending`` (scheduler.leak_counts).
+    _dlint_acquires = {
+        "kv-page": ("admit", "adopt"),
+        "host-page": ("take_pending_swapouts",),
+    }
     _dlint_releases = {"kv-page": ("finish", "release", "drop_parked", "reset")}
 
     def __init__(
@@ -141,6 +352,7 @@ class KVPagePool:
         n_lanes: int = 8,
         blocks_per_lane: int | None = None,
         max_parked: int = DEFAULT_MAX_PARKED,
+        host_bytes: int = 0,
     ):
         if page_size <= 0 or (page_size & (page_size - 1)) != 0:
             raise ValueError(
@@ -191,6 +403,17 @@ class KVPagePool:
         self._park_index: dict[tuple, int] = {}
         self._parked_pages = 0
         self._park_seq = 0
+        # host swap tier (disabled at host_bytes=0 — every tier branch
+        # below gates on enabled, so 0 restores drop-to-rebuild exactly)
+        # and the swap-out staging list: pressure eviction deposits
+        # (node_key, block_tokens, page) here for pages whose last ref
+        # just drained; the ENGINE drains it (take_pending_swapouts ->
+        # device read -> HostTier.put) before dispatching any write that
+        # could reuse the page — the donated-pytree ordering makes the
+        # read see pre-eviction bytes. Carries the node key because by
+        # drain time the page's tree entry is gone.
+        self.host_tier = HostTier(host_bytes, self.page_size)
+        self._pending_swapouts: list[tuple[tuple, tuple, int]] = []
         # counters (stats() snapshots them for /stats -> /metrics)
         self.admits = 0
         self.prefix_admits = 0
@@ -204,6 +427,8 @@ class KVPagePool:
         self.pool_resets = 0
         self.adopts = 0  # disagg: chains adopted from a peer replica
         self.adopted_pages_fresh = 0  # pages that needed a payload import
+        self.swap_in_admits = 0  # admissions served partly from the tier
+        self.host_pages_swapped_in = 0  # pages reactivated by host copy
 
     @classmethod
     def for_seq_len(
@@ -213,6 +438,7 @@ class KVPagePool:
         page_size: int = DEFAULT_PAGE_SIZE,
         pool_pages: int | None = None,
         max_parked: int = DEFAULT_MAX_PARKED,
+        host_bytes: int = 0,
     ) -> "KVPagePool":
         """THE pool-construction recipe, shared by the real engine and
         MockAsyncEngine's paged mode so the two cannot drift: validate
@@ -238,7 +464,7 @@ class KVPagePool:
         n_pages = int(n_lanes * n_blocks if pool_pages is None
                       else pool_pages)
         return cls(n_pages, bs, n_lanes, blocks_per_lane=n_blocks,
-                   max_parked=max_parked)
+                   max_parked=max_parked, host_bytes=host_bytes)
 
     # -- admission -----------------------------------------------------------
 
@@ -248,25 +474,36 @@ class KVPagePool:
         tokens: list[int],
         reserve_tokens: int,
         min_share_tokens: int = 1,
-    ) -> tuple[int, list[int], list[tuple[int, int]]]:
+    ) -> tuple[int, list[int], list[tuple[int, int]],
+               list[tuple[int, bytes]]]:
         """Reserve lane ``lane``'s pages for a request whose prompt is
         ``tokens`` and whose whole potential range is ``reserve_tokens``
-        KV slots. Returns ``(start, blocks, copies)``:
+        KV slots. Returns ``(start, blocks, copies, swapins)``:
 
         - ``start`` — prompt tokens already resident via sharing: full
-          blocks by refcount bump, plus up to one partial block served
-          copy-on-write. The caller prefills only ``tokens[start:]``
-          (always >= 1 token, the prefix-cache rule).
+          blocks by refcount bump, host-tier full blocks swapped back
+          in, plus up to one partial block served copy-on-write. The
+          caller prefills only ``tokens[start:]`` (always >= 1 token,
+          the prefix-cache rule).
         - ``blocks`` — the lane's physical pages in block order (shared
           prefix pages first), for the device page table.
         - ``copies`` — ``(src_page, dst_page)`` device copies the engine
           must apply BEFORE the tail prefill (the COW at the divergent
           block; at most one).
+        - ``swapins`` — ``(page, payload)`` host->device page writes the
+          engine must apply BEFORE the tail prefill: the prompt's chain
+          continued in the HOST TIER past the resident prefix, so those
+          blocks reactivate by copy instead of re-prefill. The pages are
+          already registered back into the prefix tree (the next
+          admission shares them resident, zero copies).
 
         ``min_share_tokens`` gates sharing like the contiguous path's
         ``prefix_min_tokens`` (<= 0 disables sharing entirely). Raises
         :class:`PoolExhausted` when the reservation cannot be served
-        even after evicting every parked session."""
+        even after evicting every parked session, and
+        :class:`HostTierCorrupt` (BEFORE any pool mutation — the tree
+        is never poisoned by a bad swapped payload) when a host-tier
+        hit fails its integrity re-hash."""
         with self._lock:
             self._release_locked(lane)  # defensive: lane must start empty
             bs = self.page_size
@@ -282,7 +519,23 @@ class KVPagePool:
                         break
                     key = (key, blk)
                     shared_pages.append(page)
-            start = len(shared_pages) * bs
+            # the chain may CONTINUE in the host tier past the resident
+            # frontier: swapped blocks reactivate into fresh pages by a
+            # host->device copy instead of a re-prefill. The walk runs
+            # before any ref/eviction side effect, so a HostTierCorrupt
+            # out of get() leaves the pool exactly as it found it.
+            hbm_key = key
+            swap_meta: list[tuple[tuple, bytes]] = []  # (block, payload)
+            if min_share_tokens > 0 and self.host_tier.enabled:
+                while (len(shared_pages) + len(swap_meta) + 1) * bs <= max_reuse:
+                    i = len(shared_pages) + len(swap_meta)
+                    blk = tuple(tokens[i * bs: (i + 1) * bs])
+                    payload = self.host_tier.get((key, blk), blk)
+                    if payload is None:
+                        break
+                    key = (key, blk)
+                    swap_meta.append((blk, payload))
+            start = (len(shared_pages) + len(swap_meta)) * bs
             # divergent-block COW probe: the best sibling block sharing a
             # leading run with our next (possibly partial) block
             cow_src = -1
@@ -307,9 +560,11 @@ class KVPagePool:
                 # this lane's blocks under the matched chain, poisoning
                 # future walks with wrong-position KV)
                 shared_pages = []
+                swap_meta = []
                 start = 0
                 cow_src, cow_len = -1, 0
                 key = _ROOT
+                hbm_key = _ROOT
             n_blocks = blocks_for(
                 max(reserve_tokens, len(tokens) + 1), bs
             )
@@ -360,7 +615,8 @@ class KVPagePool:
                     if cow_pinned:
                         self._deref_locked(cow_src)
                     raise PoolExhausted(
-                        need, len(self._free), self.n_pages
+                        need, len(self._free), self.n_pages,
+                        host_tier_full=self.host_tier.full(),
                     )
                 self._evict_parked_locked(need - len(self._free))
             if len(self._free) < need:
@@ -372,7 +628,8 @@ class KVPagePool:
                 if cow_pinned:
                     self._deref_locked(cow_src)
                 raise PoolExhausted(
-                    need, len(self._free), self.n_pages
+                    need, len(self._free), self.n_pages,
+                    host_tier_full=self.host_tier.full(),
                 )
             fresh = [self._free.pop() for _ in range(need)]
             for p in fresh:
@@ -383,19 +640,45 @@ class KVPagePool:
                 # any later admission's writes can reuse the page
                 self._deref_locked(cow_src)
             copies: list[tuple[int, int]] = []
-            if cow_src >= 0 and cow_len > 0 and fresh:
-                copies.append((cow_src, fresh[0]))
+            if cow_src >= 0 and cow_len > 0 and len(fresh) > len(swap_meta):
+                # COW only fires at the HBM frontier (a tier-extended tip
+                # is not a tree node, so the sibling probe found nothing)
+                # — swap_meta is empty here and the dst is fresh[0], but
+                # index past the swap-in pages anyway so the two claims
+                # can never alias if either walk ever changes
+                copies.append((cow_src, fresh[len(swap_meta)]))
                 start += cow_len
                 self.cow_copies += 1
+            # swapped blocks land in the LEADING fresh pages and register
+            # straight back into the prefix tree (the same duplicate rule
+            # as commit(): the walk just proved these nodes absent, and
+            # each next node chains from the one we create) — the next
+            # same-prefix admission shares them RESIDENT, zero copies.
+            # The caller must apply the (page, payload) writes before the
+            # tail prefill, exactly like the COW copies.
+            swapins: list[tuple[int, bytes]] = []
+            reg_key = hbm_key
+            for j, (blk, payload) in enumerate(swap_meta):
+                page = fresh[j]
+                child = (reg_key, blk)
+                if child not in self._nodes:
+                    self._nodes[child] = page
+                    self._page_key[page] = child
+                    self._children.setdefault(reg_key, {})[blk] = page
+                reg_key = child
+                swapins.append((page, payload))
             blocks = shared_pages + fresh
             self._lane_blocks[lane] = blocks
-            self._lane_reg[lane] = len(shared_pages)
+            self._lane_reg[lane] = len(shared_pages) + len(swap_meta)
             self._lane_tip[lane] = key
             self.admits += 1
+            if swapins:
+                self.swap_in_admits += 1
+                self.host_pages_swapped_in += len(swapins)
             if start > 0:
                 self.prefix_admits += 1
                 self.prefix_tokens_shared += start
-            return start, list(blocks), copies
+            return start, list(blocks), copies, swapins
 
     def commit(self, lane: int, tokens: list[int]) -> None:
         """Register lane ``lane``'s newly completed full blocks into the
@@ -618,14 +901,42 @@ class KVPagePool:
             self._release_locked(lane)
 
     def drop_parked(self) -> int:
-        """Evict every parked session (test/benchmark lever for the
-        park -> drop -> journal-rebuild round trip). Returns how many
-        sessions were dropped."""
+        """Evict every parked session WITHOUT staging swap-outs (the
+        test/benchmark lever for the park -> drop -> journal-rebuild
+        round trip — swapping here would turn the rebuild measurement
+        into a swap-in measurement). Returns how many sessions were
+        dropped."""
+        with self._lock:
+            n = len(self._parked)
+            while self._parked:
+                self._evict_entry_locked(next(iter(self._parked)),
+                                         swap=False)
+            return n
+
+    def swap_out_parked(self) -> int:
+        """Evict every parked session WITH swap-out staging (the bench's
+        swap-tier lever; pressure eviction does the same organically).
+        Returns how many sessions were evicted; the caller must drain
+        the staged pages through the engine (``drain_kv_swapouts``)."""
         with self._lock:
             n = len(self._parked)
             while self._parked:
                 self._evict_oldest_locked()
             return n
+
+    def take_pending_swapouts(self) -> list[tuple[tuple, tuple, int]]:
+        """Hand the engine the staged swap-outs — ``(node_key,
+        block_tokens, page)`` triples whose pages just freed under
+        pressure (one lock hold, clears the staging list). The host-page
+        ACQUIRE: every triple must reach ``HostTier.put`` or
+        ``HostTier.discard``. The caller must apply the device reads
+        BEFORE dispatching any write that could reuse the pages (the
+        donated-pytree ordering guarantees the read still sees the
+        pre-eviction bytes)."""
+        with self._lock:
+            out = self._pending_swapouts
+            self._pending_swapouts = []
+            return out
 
     def reset(self) -> None:
         """Containment: drop every lane mapping, every parked session and
@@ -648,6 +959,12 @@ class KVPagePool:
             self._park_refs.clear()
             self._park_index.clear()
             self._parked_pages = 0
+            # staged swap-outs are DISCARDED, not stored (their device
+            # bytes are exactly what containment distrusts), and the
+            # tier itself clears — nothing may be shared from before
+            # the failure, host copies included
+            self._pending_swapouts = []
+            self.host_tier.clear()
             self.pool_resets += 1
 
     # -- introspection -------------------------------------------------------
@@ -709,6 +1026,13 @@ class KVPagePool:
                 "pool_resets": self.pool_resets,
                 "pool_adopts": self.adopts,
                 "pool_adopted_pages_fresh": self.adopted_pages_fresh,
+                "pool_swap_in_admits": self.swap_in_admits,
+                "pool_host_pages_swapped_in": self.host_pages_swapped_in,
+                # staged swap-outs the engine has not drained yet: the
+                # host-page leak witness — a drained scheduler must read
+                # 0 here (scheduler.leak_counts / analysis/leakcheck.py)
+                "pool_swap_pending": len(self._pending_swapouts),
+                **self.host_tier.stats(),
             }
 
     # -- internals (callers hold _lock) --------------------------------------
@@ -743,7 +1067,7 @@ class KVPagePool:
                     self._children.pop(parent, None)
         self._free.append(page)
 
-    def _evict_entry_locked(self, pid: int) -> None:
+    def _evict_entry_locked(self, pid: int, swap: bool = True) -> None:
         blocks = self._parked.pop(pid)
         self._park_index.pop(tuple(blocks), None)
         for p in blocks:
@@ -753,6 +1077,20 @@ class KVPagePool:
                 self._parked_pages -= 1
             else:
                 self._park_refs[p] = held
+            # tiered residency: a committed page about to FREE (this
+            # deref is its last ref) is staged for swap-out instead of
+            # silently dropping to rebuild — the engine drains the
+            # staging list (device read -> HostTier.put) before any
+            # write that could reuse the page. Captured BEFORE the
+            # deref because _deref_locked removes the tree entry.
+            if (
+                swap
+                and self.host_tier.enabled
+                and self._ref[p] == 1
+                and p in self._page_key
+            ):
+                node_key = self._page_key[p]
+                self._pending_swapouts.append((node_key, node_key[1], p))
             self._deref_locked(p)
         self.parked_evicted += 1
 

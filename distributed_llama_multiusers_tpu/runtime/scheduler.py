@@ -587,8 +587,16 @@ class ContinuousBatchingScheduler:
             counts["device_ops"] = len(self._device_ops)
         pool_stats = getattr(self.engine, "pool_stats", None)
         if callable(pool_stats):
+            pstats = pool_stats() or {}
             counts["kv_lane_pages"] = int(
-                (pool_stats() or {}).get("pool_pages_in_use", 0)
+                pstats.get("pool_pages_in_use", 0)
+            )
+            # host-page kind (tiered residency): swap-outs the pool
+            # staged but no engine drain has taken to the host tier —
+            # a non-zero drained count means an eviction path lost its
+            # drain call and the pages' payloads leaked in limbo
+            counts["kv_swap_pending"] = int(
+                pstats.get("pool_swap_pending", 0)
             )
         if self.journal is not None:
             counts["journal_marks"] = int(
@@ -1087,12 +1095,21 @@ class ContinuousBatchingScheduler:
                 # active lanes is load, not engine failure. Counted on
                 # the QoS rejection surface like every other shed reason
                 # (queue_full/draining/breaker_open), so dashboards on
-                # the rejection counters see paged-pool sheds too.
+                # the rejection counters see paged-pool sheds too. The
+                # tiered-residency distinction rides the reason string:
+                # "host_tier_full" means the swap tier was enabled AND
+                # at budget when the shed fired — the operator's lever
+                # is --kv-host-bytes, not --kv-pool-pages.
+                reason = (
+                    "host_tier_full"
+                    if getattr(e, "host_tier_full", False)
+                    else "pool_exhausted"
+                )
                 note = getattr(self.queue, "note_rejection", None)
                 if note is not None:
-                    note("pool_exhausted")
+                    note(reason)
                 raise AdmissionRejected(
-                    "pool_exhausted", retry_after_s=1.0
+                    reason, retry_after_s=1.0
                 ) from e
         elif (
             self.prefix_min_tokens > 0

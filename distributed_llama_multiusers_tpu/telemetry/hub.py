@@ -167,6 +167,19 @@ class Telemetry:
             "stream-registry close (the /stats resource_leaks_total "
             "field, delta-fed); non-zero means a lifecycle leak",
         )
+        # tiered KV residency (runtime/kvpool.py HostTier): page traffic
+        # across the HBM<->host-RAM boundary as a native direction-labelled
+        # counter beside the dllama_stats_pool_host_* / dllama_stats_swap_*
+        # gauges the bridge republishes — delta-fed from the /stats
+        # swap_ins / swap_outs fields with the sync-bytes recipe (a drop
+        # means the engine's swap counters were reset: re-baseline, the
+        # counter never goes back)
+        self.kv_swap = reg.counter(
+            "dllama_kv_swap_total",
+            "KV pages moved across the residency boundary by direction "
+            "label: 'in' host-RAM->HBM reactivations, 'out' HBM->host-RAM "
+            "swap-outs (the /stats swap_ins / swap_outs fields, delta-fed)",
+        )
         self._sync_bytes_seen = 0
         self._jit_compiles_seen = 0.0
         self._resource_leaks_seen = 0.0
@@ -174,6 +187,7 @@ class Telemetry:
         self._journal_records_seen = 0.0
         self._recovered_seen = 0.0
         self._failures_seen: dict[str, float] = {}
+        self._kv_swap_seen: dict[str, float] = {"in": 0.0, "out": 0.0}
 
     # -- queue binding -------------------------------------------------------
 
@@ -472,6 +486,17 @@ class Telemetry:
                 if v > seen:
                     ctr.inc(float(v - seen))
                 setattr(self, seen_attr, float(v))
+        # tiered KV residency: direction-labelled swap-page counter,
+        # delta-fed from the engine's swap traffic counters (monotone
+        # while the engine lives; a drop means reset_swap_stats() /
+        # warmup re-baselined — re-baseline here too, counter keeps)
+        for fld, direction in (("swap_ins", "in"), ("swap_outs", "out")):
+            v = stats.get(fld)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                seen = self._kv_swap_seen[direction]
+                if v > seen:
+                    self.kv_swap.inc(float(v - seen), direction=direction)
+                self._kv_swap_seen[direction] = float(v)
         # breaker exposition (serving/breaker.py): the state gauge tracks
         # breaker_state_code verbatim; the classified-failure counter is
         # delta-fed from the engine_failures dict, same recipe as above

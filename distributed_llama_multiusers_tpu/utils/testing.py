@@ -171,7 +171,7 @@ class MockAsyncEngine:
     def __init__(self, n_lanes=4, vocab=64, seq_len=4096, step_s=0.002,
                  pipeline_depth=2, max_chunk=16, speculative=False,
                  content_keyed=False, paged=False, kv_page_size=16,
-                 kv_pool_pages=None, kv_max_parked=8):
+                 kv_pool_pages=None, kv_max_parked=8, kv_host_bytes=0):
         """``speculative=True`` opts this instance into the speculative
         families (``decode_spec`` + the in-chain
         ``decode_spec_pipelined`` / ``decode_spec_prefill_fused``),
@@ -252,11 +252,19 @@ class MockAsyncEngine:
             self.kvpool = KVPagePool.for_seq_len(
                 seq_len, n_lanes, page_size=kv_page_size,
                 pool_pages=kv_pool_pages, max_parked=kv_max_parked,
+                host_bytes=kv_host_bytes,
             )
             self._host_tables = np.asarray(
                 [self.kvpool.table_row([])] * n_lanes, np.int32
             )
             self.page_copies_applied = 0  # the mocked device COW half
+            # tiered residency (host swap tier): the engine's traffic
+            # counters, fed by the mocked device halves below
+            self.swap_ins = 0
+            self.swap_outs = 0
+            self.swap_in_bytes = 0
+            self.swap_out_bytes = 0
+            self.swap_in_ms = 0.0
             # disagg transfer mock: imported payloads keyed by page, each
             # pinned to the tree node it was imported FOR (a reused page
             # re-registered with different content falls back to the
@@ -362,10 +370,16 @@ class MockAsyncEngine:
                     min_share_tokens=1):
         """The real engine's paged admission over the REAL pool
         bookkeeping; raises the real :class:`~..runtime.kvpool.PoolExhausted`.
-        The device half is a numpy table write + a COW counter bump."""
-        start, blocks, copies = self.kvpool.admit(
+        The device half is a numpy table write + a COW counter bump; the
+        tiered-residency ordering matches the engine's (drain staged
+        swap-outs, apply host-tier swap-ins, then the table write)."""
+        start, blocks, copies, swapins = self.kvpool.admit(
             lane, list(tokens), reserve_tokens, min_share_tokens
         )
+        self.drain_kv_swapouts()
+        if swapins:
+            self.swap_in_pages([p for p, _ in swapins],
+                               [b for _, b in swapins])
         self._host_tables[int(lane)] = self.kvpool.table_row(blocks)
         self.page_copies_applied += len(copies)
         if self._content_keyed and start > 0:
@@ -377,11 +391,29 @@ class MockAsyncEngine:
             self._feed_key(lane, list(tokens[:start]), 0)
         return start
 
+    def _paged_table_row(self, blocks):
+        """The pod control plane's table-row hook (mirrors the real
+        engine): the pool's shared encoding as the int32 wire dtype."""
+        import numpy as np
+        return np.asarray(self.kvpool.table_row(list(blocks)), np.int32)
+
+    def apply_paged_admit(self, lane, row, copies):
+        """Device half of a pod admission replay on the mock: land the
+        table row and apply COW copies to the payload shadow."""
+        for src, dst in copies:
+            got = self._page_payloads.get(int(src))
+            if got is not None:
+                self._page_payloads[int(dst)] = got
+        self._host_tables[int(lane)] = row
+        self.page_copies_applied += len(copies)
+
     def paged_commit(self, lane, tokens):
         self.kvpool.commit(lane, list(tokens))
 
     def paged_finish(self, lane, park=True):
-        if self.kvpool.finish(lane, park=park):
+        held = self.kvpool.finish(lane, park=park)
+        self.drain_kv_swapouts()
+        if held:
             self._host_tables[int(lane)] = self.kvpool.table_row([])
 
     def paged_reset(self):
@@ -389,7 +421,88 @@ class MockAsyncEngine:
         self._host_tables[:] = self.kvpool.table_row([])
 
     def pool_stats(self):
-        return self.kvpool.stats() if self.kvpool is not None else {}
+        if self.kvpool is None:
+            return {}
+        stats = self.kvpool.stats()
+        stats["swap_ins"] = int(self.swap_ins)
+        stats["swap_outs"] = int(self.swap_outs)
+        stats["swap_in_bytes"] = int(self.swap_in_bytes)
+        stats["swap_out_bytes"] = int(self.swap_out_bytes)
+        stats["swap_in_ms"] = round(float(self.swap_in_ms), 3)
+        return stats
+
+    # -- host swap tier (runtime/engine.py contract; device half mocked) ---
+
+    def drain_kv_swapouts(self):
+        """Mocked device half of a swap-out drain: the 'device read' is
+        the content-canonical payload rule shared with export_kv_page —
+        imported bytes replay if the page still backs the staged node,
+        otherwise the payload is the pure function of the node key. Same
+        pool-side bookkeeping (take_pending_swapouts -> tier.put) as the
+        real engine, so leak witnesses and tier stats are exercised."""
+        import hashlib
+
+        if self.kvpool is None or not self.kvpool.host_tier.enabled:
+            return 0
+        pending = self.kvpool.take_pending_swapouts()
+        stored = 0
+        for node_key, blk_tokens, page in pending:
+            got = self._page_payloads.get(int(page))
+            if got is not None and got[0] == node_key:
+                payload = got[1]
+            else:
+                payload = hashlib.sha256(
+                    repr(node_key).encode("utf-8")
+                ).digest() * 2
+            if self.kvpool.host_tier.put(node_key, blk_tokens, payload):
+                stored += 1
+            self.swap_outs += 1
+            self.swap_out_bytes += len(payload)
+        return stored
+
+    def swap_in_pages(self, pages, payloads):
+        """Mocked device half of a batched host->device swap-in: record
+        each payload against the node its page now backs (admit()
+        registered the chain just before this call), so a later export
+        or re-swap-out round-trips the exact bytes."""
+        if self.kvpool is None:
+            raise RuntimeError("swap_in_pages needs a paged engine")
+        if len(pages) != len(payloads):
+            raise ValueError(
+                f"swap_in_pages: {len(pages)} pages vs "
+                f"{len(payloads)} payloads"
+            )
+        for page, payload in zip(pages, payloads):
+            self._page_payloads[int(page)] = (
+                self.kvpool.page_key(int(page)), bytes(payload)
+            )
+            self.swap_ins += 1
+            self.swap_in_bytes += len(payload)
+
+    def swap_out_parked(self):
+        """Evict every parked chain into the host tier (bench lever)."""
+        if self.kvpool is None:
+            return 0
+        n = self.kvpool.swap_out_parked()
+        self.drain_kv_swapouts()
+        return n
+
+    def reset_swap_stats(self):
+        self.swap_ins = 0
+        self.swap_outs = 0
+        self.swap_in_bytes = 0
+        self.swap_out_bytes = 0
+        self.swap_in_ms = 0.0
+
+    def _page_leaf_geometry(self):
+        """One page's K (or V) leaf geometry under the mock's content-
+        canonical payload convention: each half is the 32-byte sha256
+        digest, so every canonical payload is exactly 2 * half — the
+        same contract RootControlEngine's pre-broadcast validation
+        checks on the real engine."""
+        import numpy as np
+
+        return (8,), np.dtype(np.float32)
 
     def export_kv_page(self, page):
         """The real engine's disagg export, mocked content-canonically:

@@ -76,6 +76,73 @@ with open(live_path, "w") as f:
 EOF
 }
 
+# serving_prefix swap A/B CPU-smoke leg: the tiered-residency ladder
+# (park < swap < rebuild TTFT) is determinism-class evidence that needs
+# no TPU, so it proves out before the tunnel wait too. Leg A runs the
+# phase with the host tier on (bench default), leg B with
+# BENCH_KV_HOST_BYTES=0 — the escape hatch, where the middle rung
+# degenerates to rebuild and swap traffic must read zero. Both legs'
+# headline numbers (swap-in latency included) are merged into the
+# banked artifact under prefix_swap_ab_* keys — never over the TPU
+# run's own serving_prefix_* keys.
+AB_TS=$(date +%Y%m%d_%H%M%S)
+AB_ON_OUT="$DIR/prefix_swap_on_$AB_TS.out"
+BENCH_CHILD=1 BENCH_PHASE=serving_prefix BENCH_FORCE_CPU=1 GRAFT_SMALL=1 \
+  timeout 300 python bench.py > "$AB_ON_OUT" 2> "$AB_ON_OUT.err"
+AB_ON_RC=$?
+AB_OFF_OUT="$DIR/prefix_swap_off_$AB_TS.out"
+BENCH_CHILD=1 BENCH_PHASE=serving_prefix BENCH_FORCE_CPU=1 GRAFT_SMALL=1 \
+  BENCH_KV_HOST_BYTES=0 \
+  timeout 300 python bench.py > "$AB_OFF_OUT" 2> "$AB_OFF_OUT.err"
+AB_OFF_RC=$?
+echo "serving_prefix swap A/B cpu smoke rc=$AB_ON_RC/$AB_OFF_RC ($AB_ON_OUT)"
+
+merge_prefix_swap_ab() {  # $1 = banked artifact (BENCH_LIVE.json)
+  python - "$AB_ON_OUT" "$AB_ON_RC" "$AB_OFF_OUT" "$AB_OFF_RC" "$1" <<'EOF'
+import json, sys
+on_path, on_rc = sys.argv[1], int(sys.argv[2])
+off_path, off_rc = sys.argv[3], int(sys.argv[4])
+live_path = sys.argv[5]
+
+def last_json(path):
+    result = None
+    try:
+        for line in open(path):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    result = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+    except OSError:
+        pass
+    return result
+
+on, off = last_json(on_path), last_json(off_path)
+try:
+    with open(live_path) as f:
+        live = json.load(f)
+except Exception:
+    live = {}
+if on_rc == 0 and off_rc == 0 and on is not None and off is not None:
+    for leg, result in (("on", on), ("off", off)):
+        for key in ("park_ttft_ms", "swap_ttft_ms", "rebuild_ttft_ms",
+                    "swap_ins", "swap_outs", "swap_in_ms",
+                    "host_hit_rate"):
+            v = result.get(f"serving_prefix_{key}")
+            if v is not None:
+                live[f"prefix_swap_ab_{leg}_{key}"] = v
+    live["prefix_swap_ab"] = "ok"
+else:
+    live["prefix_swap_ab"] = "failed"
+    err = f"prefix_swap_ab: rc={on_rc}/{off_rc}"
+    prior = live.get("phase_errors", "")
+    live["phase_errors"] = (f"{prior}; {err}" if prior else err)[-600:]
+with open(live_path, "w") as f:
+    json.dump(live, f)
+EOF
+}
+
 attempt=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   attempt=$((attempt + 1))
@@ -91,6 +158,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if is_tpu_artifact "$OUT/bench.out"; then
     tail -1 "$OUT/bench.out" > "$REPO/BENCH_LIVE.json"
     merge_disagg_smoke "$REPO/BENCH_LIVE.json"
+    merge_prefix_swap_ab "$REPO/BENCH_LIVE.json"
     echo "TPU artifact banked" >> "$OUT/status"
     # bonus evidence while the tunnel is up; each has its own timeout
     # --update-table: a winning dequant_* combo is written back into

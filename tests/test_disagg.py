@@ -140,7 +140,7 @@ def test_cow_block_survives_transfer():
     # session 2 shares 5 full blocks + 2 tokens of block 6, then
     # diverges: admit serves the partial block copy-on-write
     forked = base[:22] + [91, 92]
-    start, _blocks, copies = a.kvpool.admit(
+    start, _blocks, copies, _sw = a.kvpool.admit(
         1, forked, reserve_tokens=len(forked) + 1, min_share_tokens=4
     )
     assert copies, "expected a COW copy at the divergent block"

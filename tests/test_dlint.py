@@ -405,6 +405,11 @@ def test_real_kvpool_guard_decls_are_collected():
     assert "_parked" in project.guarded
     assert "cow_copies" in project.guarded
     assert project.guarded["_free"][0] == frozenset({"_lock"})
+    # the swap tier's own declaration (HostTier._lock over the LRU store
+    # and its counters) must keep reaching the checker too
+    assert "_swapped" in project.guarded
+    assert "_pending_swapouts" in project.guarded
+    assert project.guarded["_swapped"][0] == frozenset({"_lock"})
 
 
 def test_guarded_by_flags_unlocked_kvpool_free_list(tmp_path):

@@ -226,7 +226,7 @@ def test_kvpool_cow_at_divergent_block():
 
     pool = KVPagePool(n_pages=16, page_size=4, n_lanes=2)
     a = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
-    start, blocks, copies = pool.admit(0, a, reserve_tokens=12,
+    start, blocks, copies, _sw = pool.admit(0, a, reserve_tokens=12,
                                        min_share_tokens=4)
     assert (start, copies) == (0, [])
     pool.commit(0, a + [11, 12])  # 3 full blocks enter the tree
@@ -234,7 +234,7 @@ def test_kvpool_cow_at_divergent_block():
 
     # b shares block 0 exactly and diverges INSIDE block 1 (after 5, 6)
     b = [1, 2, 3, 4, 5, 6, 99, 100, 101]
-    start, blocks2, copies = pool.admit(1, b, reserve_tokens=12,
+    start, blocks2, copies, _sw = pool.admit(1, b, reserve_tokens=12,
                                         min_share_tokens=4)
     assert start == 6  # 4 tokens by refcount + 2 by copy-on-write
     assert blocks2[0] == blocks[0]  # full block: same physical page
@@ -254,12 +254,12 @@ def test_kvpool_refcount_zero_page_reuse():
 
     pool = KVPagePool(n_pages=4, page_size=4, n_lanes=2, max_parked=4)
     toks = [1, 2, 3, 4, 5, 6]
-    _, blocks, _ = pool.admit(0, toks, reserve_tokens=8)
+    _, blocks, _, _sw = pool.admit(0, toks, reserve_tokens=8)
     pool.commit(0, [1, 2, 3, 4, 5, 6, 7, 8])
     pool.finish(0, park=False)  # failure path: nothing parks
     assert pool.pages_free() == 4
     # the tree nodes died with their pages: identical content shares 0
-    start2, blocks2, _ = pool.admit(1, toks, reserve_tokens=8,
+    start2, blocks2, _, _sw = pool.admit(1, toks, reserve_tokens=8,
                                     min_share_tokens=1)
     assert start2 == 0
     assert sorted(blocks2) == sorted(blocks)  # same physical pages, reused
@@ -399,7 +399,7 @@ def test_kvpool_unservable_reservation_is_not_retryable():
 
     # a servable reservation still works afterwards, sharing the parked
     # prefix untouched by the failed probe
-    start, _, _ = pool.admit(1, [1, 2, 3, 4, 5], reserve_tokens=8,
+    start, _, _, _sw = pool.admit(1, [1, 2, 3, 4, 5], reserve_tokens=8,
                              min_share_tokens=4)
     assert start == 4
 
@@ -426,7 +426,7 @@ def test_kvpool_repark_identical_chain_occupies_one_lru_slot():
 
     toks = [1, 2, 3, 4, 5]
     for _ in range(4):  # would overflow max_parked=2 without dedupe
-        start, _, _ = pool.admit(0, toks, reserve_tokens=8,
+        start, _, _, _sw = pool.admit(0, toks, reserve_tokens=8,
                                  min_share_tokens=4)
         pool.commit(0, [1, 2, 3, 4])
         pool.finish(0, park=True)
@@ -435,10 +435,10 @@ def test_kvpool_repark_identical_chain_occupies_one_lru_slot():
     assert s["pool_parked_evicted"] == 0
     assert s["pool_parked_pages"] == 2  # one page each, held once
     # both prefixes still serve copy-free
-    start, _, _ = pool.admit(1, other, reserve_tokens=8,
+    start, _, _, _sw = pool.admit(1, other, reserve_tokens=8,
                              min_share_tokens=4)
     assert start == 4
-    start, _, _ = pool.admit(0, toks, reserve_tokens=8,
+    start, _, _, _sw = pool.admit(0, toks, reserve_tokens=8,
                              min_share_tokens=4)
     assert start == 4
 
@@ -464,7 +464,7 @@ def test_kvpool_eviction_skips_zero_yield_parked_sessions():
     # shares A's 2 blocks and needs 3 fresh pages (free = 2): A is
     # pinned by this very admission (zero-yield), so the LRU pass must
     # skip it and evict only B
-    start, _, _ = pool.admit(1, a + list(range(30, 37)),
+    start, _, _, _sw = pool.admit(1, a + list(range(30, 37)),
                              reserve_tokens=17, min_share_tokens=4)
     assert start == 8
     s = pool.stats()
@@ -472,7 +472,7 @@ def test_kvpool_eviction_skips_zero_yield_parked_sessions():
     assert pool.parked_sessions() == 1  # A survives the pressure
     # and A still serves a copy-free hit afterwards
     pool.release(1)
-    start, _, _ = pool.admit(1, a + [99], reserve_tokens=9,
+    start, _, _, _sw = pool.admit(1, a + [99], reserve_tokens=9,
                              min_share_tokens=4)
     assert start == 8
 
@@ -534,7 +534,7 @@ def test_kvpool_duplicate_content_pages_freed_not_parked():
     assert s["pool_parked_pages"] == 1
     assert pool.pages_free() == 7
     # and the survivor still serves copy-free follow-ups
-    start, _, _ = pool.admit(0, toks, reserve_tokens=8,
+    start, _, _, _sw = pool.admit(0, toks, reserve_tokens=8,
                              min_share_tokens=4)
     assert start == 4
 
@@ -578,7 +578,7 @@ def test_kvpool_eviction_cannot_free_matched_shared_pages():
 
     pool = KVPagePool(n_pages=4, page_size=4, n_lanes=2, max_parked=4)
     a = [1, 2, 3, 4, 5, 6, 7]  # 7 prompt + 1 reserved slot = 2 pages
-    _, a_blocks, _ = pool.admit(0, a, reserve_tokens=8)
+    _, a_blocks, _, _sw = pool.admit(0, a, reserve_tokens=8)
     pool.commit(0, a + [8])  # both blocks full: both register + park
     pool.finish(0, park=True)  # LRU-oldest; sole holder of a's 2 pages
     b = [9, 10, 11, 12, 13, 14, 15]
@@ -591,7 +591,7 @@ def test_kvpool_eviction_cannot_free_matched_shared_pages():
     # free b's pages (a's are pinned by this very admission), and the
     # mapping must stay one-physical-page-per-block
     c = a + [8, 17]
-    start, c_blocks, _ = pool.admit(1, c, reserve_tokens=16,
+    start, c_blocks, _, _sw = pool.admit(1, c, reserve_tokens=16,
                                     min_share_tokens=4)
     assert start == 8
     assert c_blocks[:2] == a_blocks  # shared by refcount, still alive
@@ -623,7 +623,7 @@ def test_kvpool_below_threshold_admit_resets_tree_tip():
     # a prompt that REALLY starts blk+blk may share only the first blk:
     # with the stale tip, lane 1's block 0 (KV at positions 0..3) sat in
     # the tree as the chain's SECOND block and start came back 8
-    start, _, copies = pool.admit(0, blk + blk + [7], reserve_tokens=12,
+    start, _, copies, _sw = pool.admit(0, blk + blk + [7], reserve_tokens=12,
                                   min_share_tokens=4)
     assert start == 4
     assert copies == []  # blk's sibling run is below any COW win
@@ -849,6 +849,58 @@ def test_paged_park_drop_journal_rebuild_byte_identical(loaded, tmp_path):
         assert e.tokens == tok.encode(prompt)
         assert e.seed == seed
         assert e.finished
+
+
+def test_paged_three_tier_residency_byte_identical(loaded):
+    """Tiered-residency determinism pin: one seeded request replayed
+    with its prefix served from each residency tier — resident-parked
+    (refcount bump), host-RAM swapped (batched host->device copy behind
+    a sha256 re-verify), and dropped (re-prefill rebuild) — produces
+    byte-identical streams, all equal to a contiguous engine that never
+    paged at all. This is what makes the swap tier safe to enable: the
+    tier only moves WHERE bytes live, never what they are."""
+    config, params, tok = loaded
+    prompt = "aa bb cc dd ee ff gg hh 11"
+    seed = 1234
+
+    def one(sched):
+        r = Request(prompt=prompt, max_tokens=8, temperature=0.8, seed=seed)
+        sched.submit(r)
+        r.future.result(timeout=300)
+        assert r.error is None, r.error
+        return list(r.generated_tokens)
+
+    # contiguous reference: the layout-swap baseline
+    ref_eng = _engine(config, params)
+    sched = ContinuousBatchingScheduler(ref_eng, tok)
+    sched.start()
+    try:
+        ref = one(sched)
+    finally:
+        sched.stop()
+
+    eng = InferenceEngine(config, params, n_lanes=2, prefill_buckets=(8,),
+                          paged_kv=True, kv_page_size=16,
+                          kv_host_bytes=64 << 20)
+    sched = ContinuousBatchingScheduler(eng, tok)
+    sched.start()
+    try:
+        assert one(sched) == ref  # cold prefill; the session parks
+        assert eng.kvpool.parked_sessions() >= 1
+        assert one(sched) == ref  # tier 0: resident-parked refcount reuse
+        # tier 1: evict the parked pages to host RAM, then reactivate
+        assert sched.run_device_op(lambda: eng.swap_out_parked()) >= 1
+        s = eng.pool_stats()
+        assert s["swap_outs"] >= 1 and s["pool_host_pages"] >= 1
+        assert one(sched) == ref  # swap-in (hash-verified host copy)
+        assert eng.pool_stats()["swap_ins"] >= 1
+        # tier 2: drop everything, host tier included — rebuild path
+        eng.kvpool.drop_parked()
+        eng.kvpool.host_tier.clear()
+        assert one(sched) == ref  # re-prefill rebuild
+        assert eng.stats.pipeline_flushes == 0
+    finally:
+        sched.stop()
 
 
 @pytest.mark.slow  # tier-2: heavy; a faster sibling keeps this class covered in tier-1 (see pyproject markers)

@@ -266,6 +266,58 @@ def test_vocabulary_functions_exempt(tmp_path):
     assert findings == []
 
 
+# -- resource-balance: the host-page (swap tier) vocabulary -------------------
+
+TIER = """
+    class HostTier:
+        _dlint_releases = {"host-page": ("put", "discard")}
+
+        def put(self, key, blk, payload):
+            return True
+
+        def discard(self, key):
+            pass
+
+    class Pool:
+        _dlint_acquires = {"host-page": ("take_pending_swapouts",)}
+
+        def take_pending_swapouts(self):
+            return []
+"""
+
+
+def test_host_page_drain_leak_fires(tmp_path):
+    """Known-bad: a drain that takes the staged swap-outs then raises
+    before the tier stores them strands the pages — neither swapped nor
+    rebuildable (the deposit already left the pool)."""
+    findings = of(run_on(tmp_path, {"tier.py": TIER, "use.py": """
+        def drain(pool, tier, ok):
+            staged = pool.take_pending_swapouts()
+            if not ok:
+                raise RuntimeError("device read failed")
+            for page in staged:
+                tier.put(*page)
+    """}), "resource-balance")
+    assert len(findings) == 1
+    assert "host-page" in findings[0].message
+    assert "take_pending_swapouts()" in findings[0].message
+
+
+def test_host_page_drain_discard_on_failure_ok(tmp_path):
+    """Known-good: discarding the staged batch before the raise settles
+    the ownership (excuse 2 — release between acquire and raise)."""
+    findings = of(run_on(tmp_path, {"tier.py": TIER, "use.py": """
+        def drain(pool, tier, ok):
+            staged = pool.take_pending_swapouts()
+            if not ok:
+                tier.discard(staged)
+                raise RuntimeError("device read failed")
+            for page in staged:
+                tier.put(*page)
+    """}), "resource-balance")
+    assert findings == []
+
+
 # -- device-affinity ----------------------------------------------------------
 
 ENGINE = """
@@ -400,21 +452,37 @@ def test_loop_root_must_exist(tmp_path):
 def test_real_declarations_reach_the_model():
     model = build_model(DECL_FILES)
     assert set(model.kinds) == {
-        "kv-page", "session-record", "stream-entry", "journal-mark"
+        "kv-page", "host-page", "session-record", "stream-entry",
+        "journal-mark",
     }
     kv = model.kinds["kv-page"]
     assert {"admit", "adopt", "paged_admit"} == set(kv.acquires)
     assert "paged_finish" in kv.releases and "finish" in kv.releases
+    # the swap tier's staged-page kind: a drained deposit is owned until
+    # the tier stores (put) or refuses (discard) it
+    hp = model.kinds["host-page"]
+    assert set(hp.acquires) == {"take_pending_swapouts"}
+    assert set(hp.releases) == {"put", "discard"}
     assert set(model.kinds["session-record"].acquires) == {"_mirror_admit"}
     assert set(model.kinds["stream-entry"].acquires) == {"register"}
     assert set(model.kinds["journal-mark"].releases) == {"record_finish"}
     assert set(model.device_methods) == {
         "apply_paged_admit", "copy_lane", "paged_unmap_all",
         "export_kv_page", "import_kv_page",
+        "swap_out_pages", "swap_in_pages",
     }
     assert model.loop_roots == {
         ("scheduler.py", "ContinuousBatchingScheduler"): ("_run",)
     }
+
+
+def test_real_host_page_releasers_span_the_drain():
+    """The engine's drain (the only consumer of staged swap-outs) must
+    keep reaching the tier's release vocabulary — a renamed put/discard
+    would silently hollow the host-page balance check out."""
+    model = build_model(DECL_FILES + [PACKAGE / "utils" / "testing.py"])
+    releasers = model.transitive_releasers("host-page")
+    assert {"put", "discard", "drain_kv_swapouts"} <= releasers
 
 
 def test_real_loop_closure_reaches_dispatch():

@@ -178,6 +178,7 @@ def test_ring_attention_train_forward(mesh222):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # tier-2: heavy (xplane profiler capture); mesh decode parity stays tier-1 via test_engine_with_sp_mesh_matches_meshfree (see pyproject markers)
 def test_measured_sync_stats_on_mesh(mesh222):
     """engine.measured_sync_stats profiles real decode steps and splits out
     collective time — the measured analogue of the reference's per-token

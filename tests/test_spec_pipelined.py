@@ -189,6 +189,7 @@ def test_engine_spec_drafts_shape_validated(loaded):
         engine.decode_spec_prefill_fused(z, bad, z, chunk=[1, 2], tokens=z)
 
 
+@pytest.mark.slow  # tier-2: heavy; the fused-pack class stays tier-1 via test_pod_packet_replays_decode_spec_prefill_fused and the scheduler fused-admission pins (see pyproject markers)
 def test_engine_spec_prefill_fused_pack(loaded):
     """The chunk+verify composition returns the spec pack with the
     boundary pair as an extra row, and the admitting lane's carry holds

@@ -616,6 +616,44 @@ def test_sync_bytes_bridge_is_delta_fed_across_resets():
     assert float(m.group(1)) == 300
 
 
+def test_kv_swap_bridge_is_delta_fed_by_direction():
+    """The tiered-residency bridge: ``dllama_kv_swap_total`` tracks the
+    /stats ``swap_ins``/``swap_outs`` fields by DELTAS under a direction
+    label, keeping Prometheus counter semantics across stats-window
+    resets — while the verbatim ``dllama_stats_swap_*`` gauges keep the
+    endpoint-reconciliation property (same number on /stats and
+    /metrics when sampled idle)."""
+    tel = Telemetry(logger=JsonLogger(stream=io.StringIO()))
+
+    def counter(direction):
+        m = re.search(
+            r'^dllama_kv_swap_total\{direction="%s"\} (\S+)$' % direction,
+            tel.registry.render(), re.M,
+        )
+        return float(m.group(1)) if m else 0.0
+
+    tel.bridge_stats({"swap_ins": 5, "swap_outs": 2})
+    assert counter("in") == 5 and counter("out") == 2
+    tel.bridge_stats({"swap_ins": 5, "swap_outs": 4})  # only outs moved
+    assert counter("in") == 5 and counter("out") == 4
+    # stats window reset: the gauges drop to 0, the counters must NOT
+    tel.bridge_stats({"swap_ins": 0, "swap_outs": 0})
+    assert counter("in") == 5 and counter("out") == 4
+    # accrual resumes from the new baseline
+    tel.bridge_stats({"swap_ins": 3, "swap_outs": 1})
+    assert counter("in") == 8 and counter("out") == 5
+    # verbatim gauges track the raw fields, host-tier occupancy included
+    render = tel.registry.render()
+    assert re.search(r"^dllama_stats_swap_ins 3(\.0)?$", render, re.M)
+    assert re.search(r"^dllama_stats_swap_outs 1(\.0)?$", render, re.M)
+    tel.bridge_stats({"pool_host_pages": 7, "pool_host_bytes": 448,
+                      "swap_in_ms": 1.25})
+    render = tel.registry.render()
+    assert re.search(r"^dllama_stats_pool_host_pages 7(\.0)?$", render, re.M)
+    assert re.search(r"^dllama_stats_pool_host_bytes 448(\.0)?$", render, re.M)
+    assert re.search(r"^dllama_stats_swap_in_ms 1\.25$", render, re.M)
+
+
 def test_observe_sync_probe_feeds_histogram():
     """``observe_sync_probe`` turns a measured_step_breakdown dict into one
     dllama_sync_seconds observation per probed step; wall-only breakdowns
