@@ -4,7 +4,7 @@
 NATIVE_DIR := distributed_llama_multiusers_tpu/native
 NATIVE_SO := $(NATIVE_DIR)/libdllama_native.so
 
-.PHONY: all native test verify lint lockgraph protocol jitcheck leakcheck kernelcheck hooks sanitize dryrun chaos fleet clean
+.PHONY: all native test verify lint lockgraph protocol jitcheck leakcheck kernelcheck hooks sanitize dryrun chaos fleet tracecheck check clean
 
 all: native
 
@@ -79,6 +79,25 @@ chaos:
 # `verify`.
 fleet:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+
+# Tracing gate (docs/OBSERVABILITY.md "Distributed tracing", ISSUE 20):
+# the fleet-trace suite — wire-format mint/parse/accept, span-ring
+# cursors and per-track drop accounting, clock-offset-corrected
+# cross-replica merge, phase attribution end to end, and THE pin: a
+# mid-stream migration yields ONE merged Perfetto timeline with every
+# span carrying the client's trace id, the migration.gap slice bridging
+# the splice, and summary.phases.migration_gap_ms reconciling with the
+# router histogram. Mock-engine based: runs in seconds, no accelerator.
+# Run it before shipping telemetry/, fleet/router.py, or summary-schema
+# changes; the same tests ride tier-1 via `verify`.
+tracecheck:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_tracectx.py -q
+
+# The pre-ship bundle: the cheap static gate first, then the full
+# tier-1 suite, then the tracing gate explicitly (it already rides
+# `verify`; running it last gives a focused tail signal when the suite
+# output is long). One command for "is this shippable".
+check: lint verify tracecheck
 
 # Reviewer aid for new lock/broadcast code (ROADMAP items 2-4): the
 # statically computed lock-order DAG, DOT on stdout (waived edges

@@ -1951,7 +1951,7 @@ def _phase_serving_fleet(config, small):
             rbase + "/v1/completions", data=_json.dumps(body).encode(),
             headers={"Content-Type": "application/json"},
         )
-        texts, stamps, err = [], [], None
+        texts, stamps, err, phases = [], [], None, None
         try:
             with urllib.request.urlopen(req, timeout=240) as resp:
                 for line in resp:
@@ -1966,10 +1966,19 @@ def _phase_serving_fleet(config, small):
                     if ch.get("finish_reason") is None:
                         texts.append(ch.get("text", ""))
                         stamps.append(time.perf_counter())
+                    else:
+                        # terminal chunk: the per-request phases record
+                        # (queue/prefill/decode/ITL/migration gap) the
+                        # router stamped its gap attribution into
+                        s = p.get("summary")
+                        if isinstance(s, dict) and isinstance(
+                            s.get("phases"), dict
+                        ):
+                            phases = s["phases"]
         except Exception as e:  # noqa: BLE001 — the ledger records it
             err = f"{type(e).__name__}"
         with lock:
-            results[i] = ("".join(texts), stamps, t_submit, err)
+            results[i] = ("".join(texts), stamps, t_submit, err, phases)
 
     rng = np.random.default_rng(23)
     intervals = rng.exponential(0.04, n_requests)
@@ -2016,8 +2025,8 @@ def _phase_serving_fleet(config, small):
     ttfts = {"short": [], "long": []}
     tbts = {"short": [], "long": []}
     for i in range(n_requests):
-        text, stamps, t_submit, err = results.get(
-            i, ("", [], t0, "no_result")
+        text, stamps, t_submit, err, _phases = results.get(
+            i, ("", [], t0, "no_result", None)
         )
         if err is not None:
             failed += 1
@@ -2045,9 +2054,30 @@ def _phase_serving_fleet(config, small):
         s = sorted(vals)
         return round(s[min(len(s) - 1, int(q * len(s)))], 1)
 
+    # per-request phase attribution (telemetry/tracectx.py PHASE_KEYS):
+    # the replica-reported records off the terminal chunks, with the
+    # router's migration-gap stamp — where each stream's wall time went
+    phase_recs = [
+        r[4] for r in results.values() if r[3] is None and r[4]
+    ]
+
+    def phase_vals(key):
+        return [
+            float(p[key]) for p in phase_recs
+            if isinstance(p.get(key), (int, float))
+        ]
+
     stats = router.handle_stats()
     mig_hist = router.registry.get("dllama_router_migration_seconds")
     mig_p50 = mig_hist.quantile(0.5) if mig_hist.count else None
+    # the router-side aggregation of the SAME records: its ttft series
+    # must reconcile with the client-collected phases (the histogram is
+    # bucket-interpolated — a coarse estimate, reported as such)
+    phase_hist = router.registry.get("dllama_request_phase_seconds")
+    router_ttft_p95_s = (
+        phase_hist.quantile(0.95, phase="ttft_ms")
+        if phase_hist is not None else None
+    )
     router.close()
     rhttpd.shutdown()
     fleet_drained = True
@@ -2072,11 +2102,17 @@ def _phase_serving_fleet(config, small):
         "serving_fleet_ttft_p95_ms": pct(
             ttfts["short"] + ttfts["long"], 0.95
         ),
+        "serving_fleet_ttft_p99_ms": pct(
+            ttfts["short"] + ttfts["long"], 0.99
+        ),
         "serving_fleet_tbt_p50_ms": pct(
             tbts["short"] + tbts["long"], 0.50
         ),
         "serving_fleet_tbt_p95_ms": pct(
             tbts["short"] + tbts["long"], 0.95
+        ),
+        "serving_fleet_tbt_p99_ms": pct(
+            tbts["short"] + tbts["long"], 0.99
         ),
         # the length-class split: what disagg routing acts on (long
         # prompts here ride the monolithic fallback — no prefill-role
@@ -2109,6 +2145,34 @@ def _phase_serving_fleet(config, small):
         # per-replica leak_counts() asserted zero above — the drained
         # replica AND the killed one both released every mirror/page
         "serving_fleet_leaked_resources": 0 if fleet_drained else None,
+        # per-phase latency attribution (replica-reported phases records
+        # off the terminal chunks + the router's migration-gap stamp):
+        # where completed streams' wall time went, phase by phase
+        "serving_fleet_phase_records": len(phase_recs),
+        "serving_fleet_phase_queue_wait_p95_ms": pct(
+            phase_vals("queue_wait_ms"), 0.95
+        ),
+        "serving_fleet_phase_prefill_p95_ms": pct(
+            phase_vals("prefill_ms"), 0.95
+        ),
+        "serving_fleet_phase_decode_p95_ms": pct(
+            phase_vals("decode_ms"), 0.95
+        ),
+        "serving_fleet_phase_itl_p50_ms": pct(
+            phase_vals("itl_p50_ms"), 0.50
+        ),
+        "serving_fleet_phase_itl_p99_ms": pct(
+            phase_vals("itl_p99_ms"), 0.95
+        ),
+        "serving_fleet_phase_migration_gap_max_ms": round(
+            max(phase_vals("migration_gap_ms"), default=0.0), 1
+        ),
+        # the router-side dllama_request_phase_seconds aggregation of
+        # the same records (bucket-interpolated estimate)
+        "serving_fleet_router_phase_ttft_p95_ms": (
+            round(router_ttft_p95_s * 1e3, 1)
+            if router_ttft_p95_s is not None else None
+        ),
     }
 
 
@@ -2237,7 +2301,7 @@ def _phase_serving_disagg(config, small):
                               "stream": True}).encode(),
             headers={"Content-Type": "application/json"},
         )
-        texts, stamps, err, served_by = [], [], None, None
+        texts, stamps, err, served_by, phases = [], [], None, None, None
         try:
             with urllib.request.urlopen(req, timeout=240) as resp:
                 served_by = resp.headers.get("X-DLlama-Replica")
@@ -2253,11 +2317,20 @@ def _phase_serving_disagg(config, small):
                     if ch.get("finish_reason") is None:
                         texts.append(ch.get("text", ""))
                         stamps.append(time.perf_counter())
+                    else:
+                        # terminal chunk: the per-request phases record
+                        # (the hand-off's decode side reports it for the
+                        # long stream)
+                        s = p.get("summary")
+                        if isinstance(s, dict) and isinstance(
+                            s.get("phases"), dict
+                        ):
+                            phases = s["phases"]
         except Exception as e:  # noqa: BLE001 — the ledger records it
             err = f"{type(e).__name__}"
         with lock:
             results[tag] = ("".join(texts), stamps, t_submit, err,
-                            served_by)
+                            served_by, phases)
 
     rng = np.random.default_rng(31)
 
@@ -2278,7 +2351,7 @@ def _phase_serving_disagg(config, small):
     def tbts_of(tags):
         out = []
         for tag in tags:
-            _, stamps, _, err, _ = results[tag]
+            _, stamps, _, err, _, _ = results[tag]
             if err is None:
                 out.extend(
                     (b - a) * 1e3 for a, b in zip(stamps, stamps[1:])
@@ -2302,7 +2375,9 @@ def _phase_serving_disagg(config, small):
              + [(f"b{i}", p) for i, p in enumerate(shorts_b)])
     tbt_co_p95 = pct(tbts_of([f"b{i}" for i in range(n_short)]), 0.95)
 
-    long_text, long_stamps, long_t0, long_err, long_served = results["long"]
+    long_text, long_stamps, long_t0, long_err, long_served, long_phases = (
+        results["long"]
+    )
     assert long_err is None, f"long stream failed: {long_err}"
     # acceptance: the long prompt ROUTED to the prefill-role replica
     assert long_served == "p0", (
@@ -2350,13 +2425,18 @@ def _phase_serving_disagg(config, small):
     threading.Thread(target=replicas[0]["sched"].stop, daemon=True).start()
     router.scrape_once()
     run_wave([("long_fb", long_b)])
-    fb_text, _, _, fb_err, fb_served = results["long_fb"]
+    fb_text, _, _, fb_err, fb_served, _fb_phases = results["long_fb"]
     assert fb_err is None, f"post-kill long stream failed: {fb_err}"
     assert fb_served in ("d0", "m0"), fb_served
     assert fb_text == oracle[long_b], "monolithic fallback diverged"
 
     hand_hist = router.registry.get("dllama_router_disagg_handoff_seconds")
     hand_p50 = hand_hist.quantile(0.5) if hand_hist.count else None
+    phase_hist = router.registry.get("dllama_request_phase_seconds")
+    router_ttft_p95_s = (
+        phase_hist.quantile(0.95, phase="ttft_ms")
+        if phase_hist is not None else None
+    )
     router.close()
     rhttpd.shutdown()
     for r in replicas[1:]:
@@ -2373,6 +2453,24 @@ def _phase_serving_disagg(config, small):
     long_ttft_ms = (
         round((long_stamps[0] - long_t0) * 1e3, 1) if long_stamps else None
     )
+    # fleet-wide latency attribution: client-observed TTFT/ITL over every
+    # successful stream, plus the per-request phases records the replicas
+    # attached to their terminal chunks (satellite of the tracing PR)
+    ttfts = [
+        (r[1][0] - r[2]) * 1e3
+        for r in results.values() if r[3] is None and r[1]
+    ]
+    itls = tbts_of([t for t in results if results[t][3] is None])
+    phase_recs = [
+        r[5] for r in results.values() if r[3] is None and r[5]
+    ]
+
+    def phase_vals(key):
+        return [
+            float(p[key]) for p in phase_recs
+            if isinstance(p.get(key), (int, float))
+        ]
+
     return {
         "serving_disagg_replicas": 3,
         "serving_disagg_short_requests": 2 * n_short,
@@ -2393,6 +2491,32 @@ def _phase_serving_disagg(config, small):
         "serving_disagg_tbt_ratio": (
             round(tbt_co_p95 / tbt_base_p95, 3)
             if tbt_base_p95 else None
+        ),
+        "serving_disagg_ttft_p50_ms": pct(ttfts, 0.50),
+        "serving_disagg_ttft_p95_ms": pct(ttfts, 0.95),
+        "serving_disagg_ttft_p99_ms": pct(ttfts, 0.99),
+        "serving_disagg_itl_p50_ms": pct(itls, 0.50),
+        "serving_disagg_itl_p95_ms": pct(itls, 0.95),
+        "serving_disagg_itl_p99_ms": pct(itls, 0.99),
+        "serving_disagg_phase_records": len(phase_recs),
+        "serving_disagg_phase_prefill_p95_ms": pct(
+            phase_vals("prefill_ms"), 0.95
+        ),
+        "serving_disagg_phase_decode_p95_ms": pct(
+            phase_vals("decode_ms"), 0.95
+        ),
+        "serving_disagg_phase_queue_wait_p95_ms": pct(
+            phase_vals("queue_wait_ms"), 0.95
+        ),
+        "serving_disagg_phase_swap_in_max_ms": round(
+            max(phase_vals("swap_in_ms"), default=0.0), 1
+        ),
+        "serving_disagg_phase_migration_gap_max_ms": round(
+            max(phase_vals("migration_gap_ms"), default=0.0), 1
+        ),
+        "serving_disagg_router_phase_ttft_p95_ms": (
+            round(router_ttft_p95_s * 1e3, 1)
+            if router_ttft_p95_s is not None else None
         ),
         "serving_disagg_byte_identical": True,  # asserted above
         "serving_disagg_monolithic_fallback_ok": True,  # asserted above

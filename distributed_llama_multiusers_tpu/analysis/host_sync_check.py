@@ -53,6 +53,10 @@ SCOPE = (
     # registered file-by-file because scope matching is suffix-based
     "telemetry/__init__.py", "telemetry/hub.py", "telemetry/spans.py",
     "telemetry/metrics.py", "telemetry/trace.py", "telemetry/logs.py",
+    # the fleet trace context rides every hop the router makes AND the
+    # replica admission path (journal admit records) — pure stdlib by
+    # the same contract as the rest of telemetry/
+    "telemetry/tracectx.py",
     # failure containment rides the serving loop too: the breaker is fed
     # from every engine step, the watchdog brackets every blocking call,
     # and the fault hooks sit inside the dispatch paths — none of them

@@ -93,7 +93,8 @@ def classify_prompt(body: dict,
 
 
 def fetch_pages(host: str, port: int, request_id: int,
-                timeout: float = DEFAULT_TIMEOUT_S) -> dict | None:
+                timeout: float = DEFAULT_TIMEOUT_S,
+                trace: str | None = None) -> dict | None:
     """GET the session's KV-page bundle off the prefill replica.
     ``None`` when the replica has nothing to ship (contiguous engine,
     session already finished, or an error reply) — the hand-off then
@@ -104,7 +105,7 @@ def fetch_pages(host: str, port: int, request_id: int,
     try:
         status, body, _ = _request_json(
             host, port, "GET", f"/admin/kvpages/{int(request_id)}",
-            timeout=timeout,
+            timeout=timeout, trace=trace,
         )
     except _TRANSPORT_ERRORS:
         return None
@@ -114,7 +115,8 @@ def fetch_pages(host: str, port: int, request_id: int,
 
 
 def push_pages(host: str, port: int, bundle: dict,
-               timeout: float = DEFAULT_TIMEOUT_S) -> dict:
+               timeout: float = DEFAULT_TIMEOUT_S,
+               trace: str | None = None) -> dict:
     """POST a page bundle to the decode replica's ``/admin/kvimport``.
     Returns the adoption receipt (``{"pages", "fresh", "reused"}``).
     Raises :class:`HandoffAborted` on any non-200 — including the
@@ -125,7 +127,7 @@ def push_pages(host: str, port: int, bundle: dict,
     try:
         status, body, _ = _request_json(
             host, port, "POST", "/admin/kvimport", body=bundle,
-            timeout=timeout,
+            timeout=timeout, trace=trace,
         )
     except _TRANSPORT_ERRORS as e:
         raise HandoffAborted("import_transport",
@@ -140,7 +142,8 @@ def push_pages(host: str, port: int, bundle: dict,
 def hand_off(src_host: str, src_port: int, request_id: int,
              dst_host: str, dst_port: int,
              timeout: float = DEFAULT_TIMEOUT_S,
-             read_timeout: float | None = None):
+             read_timeout: float | None = None,
+             trace: str | None = None):
     """Move a live session from the prefill replica (``src``) to the
     decode replica (``dst``). Returns ``(conn, resp, new_request_id,
     receipt)`` — the reattached SSE stream on the decode replica (from
@@ -151,7 +154,10 @@ def hand_off(src_host: str, src_port: int, request_id: int,
 
     ``timeout`` bounds every admin exchange; ``read_timeout`` (default:
     same) bounds reads on the reattached stream, which waits on
-    generation — callers pass their generation-length bound."""
+    generation — callers pass their generation-length bound. ``trace``
+    (the request's wire-form fleet trace context) rides every admin hop
+    as ``X-DLlama-Trace``; the ticket's own ``trace`` field is what
+    re-joins the decode-side session to the original trace."""
     from ..fleet.migrate import (
         MigrationShed,
         fetch_ticket,
@@ -159,23 +165,26 @@ def hand_off(src_host: str, src_port: int, request_id: int,
         open_stream,
     )
 
-    ticket = fetch_ticket(src_host, src_port, request_id, timeout=timeout)
+    ticket = fetch_ticket(src_host, src_port, request_id, timeout=timeout,
+                          trace=trace)
     if ticket is None:
         raise HandoffAborted(
             "no_ticket",
             f"request {request_id} has no exportable session on the "
             "prefill replica (not admitted yet, or already finished)",
         )
-    bundle = fetch_pages(src_host, src_port, request_id, timeout=timeout)
+    bundle = fetch_pages(src_host, src_port, request_id, timeout=timeout,
+                         trace=trace)
     receipt = {"pages": 0, "fresh": 0, "reused": 0}
     if bundle is not None and bundle.get("blocks"):
         # pages BEFORE the ticket: adoption must be visible to the
         # decode replica's admission, or the migrated session prefills
         # from scratch and the transfer bought nothing
-        receipt = push_pages(dst_host, dst_port, bundle, timeout=timeout)
+        receipt = push_pages(dst_host, dst_port, bundle, timeout=timeout,
+                             trace=trace)
     try:
         injected = inject_session(dst_host, dst_port, ticket,
-                                  timeout=timeout)
+                                  timeout=timeout, trace=trace)
     except MigrationShed as e:
         raise HandoffAborted("decode_shed", str(e)) from e
     except _TRANSPORT_ERRORS as e:

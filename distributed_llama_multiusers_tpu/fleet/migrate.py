@@ -35,6 +35,8 @@ from __future__ import annotations
 import http.client
 import json
 
+from ..telemetry.tracectx import TRACE_HEADER
+
 DEFAULT_TIMEOUT_S = 10.0
 
 
@@ -55,14 +57,19 @@ class MigrationShed(RuntimeError):
 
 def _request_json(host: str, port: int, method: str, path: str,
                   body: dict | None = None,
-                  timeout: float = DEFAULT_TIMEOUT_S):
+                  timeout: float = DEFAULT_TIMEOUT_S,
+                  trace: str | None = None):
     """One JSON exchange; returns ``(status, parsed_body, headers)``.
     Raises ``OSError``/``http.client.HTTPException`` on transport
-    failure — the caller's signal to mark the replica dead."""
+    failure — the caller's signal to mark the replica dead. ``trace``
+    (the wire-form context) rides as ``X-DLlama-Trace`` so the admin
+    hop itself is attributable to the request's fleet trace."""
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
         payload = None if body is None else json.dumps(body).encode()
         headers = {"Content-Type": "application/json"} if payload else {}
+        if trace:
+            headers[TRACE_HEADER] = str(trace)
         conn.request(method, path, body=payload, headers=headers)
         resp = conn.getresponse()
         raw = resp.read()
@@ -76,13 +83,14 @@ def _request_json(host: str, port: int, method: str, path: str,
 
 
 def fetch_ticket(host: str, port: int, request_id: int,
-                 timeout: float = DEFAULT_TIMEOUT_S) -> dict | None:
+                 timeout: float = DEFAULT_TIMEOUT_S,
+                 trace: str | None = None) -> dict | None:
     """Fetch a live session's migration ticket from its source replica.
     ``None`` when the session is unknown/already finished (a completed
     stream needs no ticket)."""
     status, body, _ = _request_json(
         host, port, "GET", f"/admin/session/{int(request_id)}",
-        timeout=timeout,
+        timeout=timeout, trace=trace,
     )
     if status != 200 or "seed" not in body:
         return None
@@ -90,14 +98,19 @@ def fetch_ticket(host: str, port: int, request_id: int,
 
 
 def inject_session(host: str, port: int, ticket: dict,
-                   timeout: float = DEFAULT_TIMEOUT_S) -> dict:
+                   timeout: float = DEFAULT_TIMEOUT_S,
+                   trace: str | None = None) -> dict:
     """Hand a ticket to a migration target (``POST /admin/migrate``).
     Returns the target's answer (``request_id`` — the ORIGINAL id, the
     reattach key — and ``stream_path``). Raises :class:`MigrationShed`
     on a typed 429/503 and ``ValueError`` on a non-retryable refusal
-    (bad record / missing resume registry)."""
+    (bad record / missing resume registry). The ticket's own ``trace``
+    field (the admit wire record carries it) is what re-joins the
+    REGENERATED stream to the original fleet trace; ``trace`` here only
+    attributes the inject hop itself."""
     status, body, headers = _request_json(
         host, port, "POST", "/admin/migrate", body=ticket, timeout=timeout,
+        trace=trace,
     )
     if status == 200:
         return body

@@ -47,6 +47,21 @@ counters), ``GET /metrics`` (Prometheus text via telemetry/metrics.py:
 per-replica route counts, shed retries, the migration latency
 histogram), ``GET /health`` (200 while at least one replica is
 eligible).
+
+Fleet-wide distributed tracing (telemetry/tracectx.py,
+docs/OBSERVABILITY.md): the router MINTS a trace context per request
+(or accepts a valid client ``X-DLlama-Trace``) and propagates it on
+every hop — forwards, retries, redispatches, migration ticket
+fetch/inject, disagg hand-off — so one request's spans share one trace
+id across every process that touched it. The router keeps its OWN span
+ring (route/queue-wait slices, migration gaps, hand-off windows) and
+merges it with the replicas' rings on ``GET /trace/<trace_id>``:
+per-replica clock offsets estimated from the ``/load`` scrape
+(offset = local scrape midpoint − the replica's ``trace_clock_us``
+stamp, uncertainty = RTT/2) align every ring onto the router's
+timebase — applied, and stamped visibly onto every migrated event.
+Replica-reported per-request ``phases`` records aggregate into the
+``dllama_request_phase_seconds{phase=...}`` histogram on ``/metrics``.
 """
 
 from __future__ import annotations
@@ -63,7 +78,11 @@ from ..disagg.prefill import (
     classify_prompt,
     hand_off,
 )
+from ..lockcheck import make_lock
 from ..telemetry.metrics import MetricsRegistry, log_buckets
+from ..telemetry.spans import SpanTracer
+from ..telemetry.trace import merge_chrome_traces, tracer_chrome_trace
+from ..telemetry.tracectx import TRACE_HEADER, PhaseAccumulator, TraceContext
 from .balancer import (
     DEFAULT_AFFINITY_BLOCKS,
     DEFAULT_BLOCK_CHARS,
@@ -103,7 +122,7 @@ class _StreamSession:
 
     __slots__ = ("key", "request_id", "ticket", "deltas_out",
                  "chars_out", "terminal_seen", "pending_error",
-                 "migrations", "handoff_due")
+                 "migrations", "handoff_due", "trace", "gap_ms")
 
     def __init__(self, key):
         self.key = key  # affinity key (None = keyless)
@@ -118,12 +137,27 @@ class _StreamSession:
         # when the stream lands on a prefill-role replica, cleared at
         # the (single) attempt so a fallback never retries forever
         self.handoff_due = False
+        # fleet trace context (wire form): rides every hop this stream
+        # takes as X-DLlama-Trace; the ticket's own trace field re-joins
+        # migrated regenerations to the same trace id
+        self.trace = None
+        # client-visible dead air accumulated across migrations/hand-offs
+        # (break detected -> first resumed byte): the router — the only
+        # process that saw the whole gap — stamps it into the terminal
+        # phases record it forwards
+        self.gap_ms = 0.0
 
 
 class FleetRouter:
     """The routing core + HTTP front-end. ``serve()`` mirrors
     :class:`~..server.http.ApiServer.serve` (returns the bound
     ``ThreadingHTTPServer``; the caller runs ``serve_forever``)."""
+
+    # dlint guarded-by declaration (analysis/lock_check.py): the
+    # per-replica clock-offset table is written by concurrent scrape
+    # probe threads and read by /trace/<id> merges — only under
+    # `_clock_lock`. Machine-checked by `make lint`.
+    _dlint_guarded_by = {("_clock_lock",): ("_clock_offsets",)}
 
     def __init__(self, replicas, balancer: FleetBalancer | None = None,
                  affinity_block_chars: int = DEFAULT_BLOCK_CHARS,
@@ -200,6 +234,24 @@ class FleetRouter:
             "first prefill delta -> decode stream reattached",
             buckets=MIGRATION_BUCKETS_S,
         )
+        # fleet tracing: the router's own span ring (route/queue-wait
+        # slices, migration gaps, hand-off windows — the rows the merged
+        # /trace/<id> timeline leads with), the per-request phase
+        # aggregation fed from replica-reported `phases` records, and
+        # the per-replica clock-offset table the merge aligns with
+        self.tracer = SpanTracer()
+        self.phase_acc = PhaseAccumulator()
+        self._m_phase_s = self.registry.labelled_histogram(
+            "dllama_request_phase_seconds",
+            "per-request phase attribution (seconds; phase label is the "
+            "phases-record key, ms fields observed /1000) aggregated "
+            "router-side from replica-reported phase records",
+        )
+        self._clock_lock = make_lock("FleetRouter._clock_lock")
+        # rid -> (offset_us, uncertainty_us): what to ADD to that
+        # replica's /trace timestamps to land them on the router's
+        # timebase, and the RTT/2 error bound of the estimate
+        self._clock_offsets: dict[str, tuple[float, float]] = {}
         self._stop_evt = threading.Event()
         self._scrape_thread: threading.Thread | None = None
         self._httpd: ThreadingHTTPServer | None = None
@@ -231,29 +283,107 @@ class FleetRouter:
         full 2s timeout) must not stall the healthy replicas' load and
         draining freshness behind it, so a pass costs max(one probe),
         never sum."""
-
-        def probe(state):
-            host, port = state.host_port()
-            try:
-                status, body, _ = _request_json(
-                    host, port, "GET", "/load", timeout=2.0
-                )
-            except _TRANSPORT_ERRORS:
-                self.balancer.note_scrape_failed(state.rid)
-                return
-            if status == 200 and "queue_depth" in body:
-                self.balancer.update_load(state.rid, body)
-            else:
-                self.balancer.note_scrape_failed(state.rid)
-
         threads = [
-            threading.Thread(target=probe, args=(s,), daemon=True)
+            threading.Thread(
+                target=self._probe_load, args=(s,), daemon=True
+            )
             for s in self.balancer.replicas()
         ]
         for t in threads:
             t.start()
         for t in threads:
             t.join(3.0)  # bounded by the probe's own 2s timeout
+
+    def _probe_load(self, state: ReplicaState) -> None:
+        """One /load probe: balancer freshness plus the clock-offset
+        estimate the fleet trace merge needs. The probe is bracketed
+        with local ``perf_counter`` stamps; the replica's ``/load``
+        carries ``trace_clock_us`` (its CURRENT position on its /trace
+        timebase), so offset = local scrape midpoint (on the router's
+        trace timebase) − that stamp, with RTT/2 as the error bound —
+        perf_counter origins are per-process, there is no shared clock
+        to read."""
+        host, port = state.host_port()
+        t0 = time.perf_counter()
+        try:
+            status, body, _ = _request_json(
+                host, port, "GET", "/load", timeout=2.0
+            )
+        except _TRANSPORT_ERRORS:
+            self.balancer.note_scrape_failed(state.rid)
+            return
+        t1 = time.perf_counter()
+        if status == 200 and "queue_depth" in body:
+            self.balancer.update_load(state.rid, body)
+            clock = body.get("trace_clock_us")
+            if isinstance(clock, (int, float)):
+                mid_us = ((t0 + t1) / 2 - self.tracer.origin) * 1e6
+                with self._clock_lock:
+                    self._clock_offsets[state.rid] = (
+                        mid_us - float(clock), (t1 - t0) / 2 * 1e6,
+                    )
+        else:
+            self.balancer.note_scrape_failed(state.rid)
+
+    def clock_offset(self, rid: str) -> tuple[float, float] | None:
+        """The latest (offset_us, uncertainty_us) estimate for ``rid``,
+        or None before its first successful scrape."""
+        with self._clock_lock:
+            return self._clock_offsets.get(rid)
+
+    def merged_trace(self, trace_id: str) -> dict:
+        """``GET /trace/<trace_id>``: ONE Perfetto timeline for a fleet
+        trace. Fans ``/trace?trace_id=`` out to every replica, aligns
+        each ring onto the router's timebase with the scraped clock
+        offsets (replicas with no estimate yet get one probed inline —
+        this is a debug surface, an extra RTT is fine), and merges with
+        the router's own spans at offset 0. A dead replica contributes
+        nothing — its ring died with it; the merge is every ring still
+        reachable, honestly labelled via per-event ``span_source``."""
+        parts = [(
+            "router",
+            tracer_chrome_trace(self.tracer, trace_id=trace_id),
+            0.0, 0.0,
+        )]
+        for state in self.balancer.replicas():
+            if self.clock_offset(state.rid) is None:
+                self._probe_load(state)
+            host, port = state.host_port()
+            try:
+                status, doc, _ = _request_json(
+                    host, port, "GET", f"/trace?trace_id={trace_id}",
+                    timeout=self.connect_timeout_s,
+                )
+            except _TRANSPORT_ERRORS:
+                continue
+            if status != 200 or not isinstance(doc, dict):
+                continue
+            off = self.clock_offset(state.rid) or (0.0, 0.0)
+            parts.append((state.rid, doc, off[0], off[1]))
+        return merge_chrome_traces(parts)
+
+    def observe_phases(self, phases) -> None:
+        """Fold one replica-reported ``phases`` record into the fleet
+        aggregation: the /stats counts/sums (PhaseAccumulator validates
+        and filters) and the ``dllama_request_phase_seconds{phase=...}``
+        histogram (ms fields observed as seconds)."""
+        rec = self.phase_acc.observe(phases)
+        if not rec:
+            return
+        for k, v in rec.items():
+            self._m_phase_s.observe(v / 1e3, phase=k)
+
+    def _harvest_phases(self, data: bytes) -> None:
+        """Pull the ``summary.phases`` record off a buffered completion
+        body the router just proxied. Best-effort by design — tracing
+        and attribution never fail a response."""
+        try:
+            body = json.loads(data)
+            phases = body["summary"]["phases"]
+        except (ValueError, TypeError, KeyError):
+            return
+        if isinstance(phases, dict):
+            self.observe_phases(phases)
 
     def _scrape_loop(self) -> None:
         while not self._stop_evt.wait(self.scrape_interval_s):
@@ -302,6 +432,19 @@ class FleetRouter:
             ),
         }
         out.update(self.balancer.stats())
+        # fleet tracing surfaces: the router's own ring occupancy (an
+        # evicting ring is visible, not silent), the per-replica clock
+        # offsets behind /trace/<id>'s alignment, and the aggregated
+        # phase-attribution counts/sums
+        out.update(self.tracer.counts())
+        with self._clock_lock:
+            out["clock_offset_us"] = {
+                rid: round(v[0], 1) for rid, v in self._clock_offsets.items()
+            }
+            out["clock_uncertainty_us"] = {
+                rid: round(v[1], 1) for rid, v in self._clock_offsets.items()
+            }
+        out.update(self.phase_acc.snapshot())
         return out
 
     def handle_metrics(self) -> str:
@@ -321,11 +464,14 @@ class FleetRouter:
         return reason, retry
 
     def _forward_once(self, state: ReplicaState, path: str,
-                      body_bytes: bytes, streaming: bool):
+                      body_bytes: bytes, streaming: bool,
+                      trace: str | None = None):
         """POST to one replica. Returns ``("ok", conn, resp)`` for a
         streaming 200 (caller owns the connection), ``("done", status,
         data, content_type)`` for a buffered answer, or ``("shed",
-        reason, retry_s)`` / ``("dead", None, None)``."""
+        reason, retry_s)`` / ``("dead", None, None)``. ``trace`` (wire
+        form) rides as ``X-DLlama-Trace`` — the replica stamps it onto
+        the request's spans and journal admit record."""
         host, port = state.host_port()
         # two-phase timeout: a SHORT connect bound (a dead replica whose
         # listener socket lingers — SIGKILL mid-accept-backlog — must
@@ -335,11 +481,13 @@ class FleetRouter:
         conn = http.client.HTTPConnection(
             host, port, timeout=self.connect_timeout_s
         )
+        headers = {"Content-Type": "application/json"}
+        if trace:
+            headers[TRACE_HEADER] = trace
         try:
             conn.connect()
             conn.sock.settimeout(self.read_timeout_s)
-            conn.request("POST", path, body=body_bytes,
-                         headers={"Content-Type": "application/json"})
+            conn.request("POST", path, body=body_bytes, headers=headers)
             resp = conn.getresponse()
         except _TRANSPORT_ERRORS:
             conn.close()
@@ -366,13 +514,21 @@ class FleetRouter:
         conn.close()
         return ("done", resp.status, data, (ctype, served_by))
 
-    def route(self, path: str, body: dict, sse):
+    def route(self, path: str, body: dict, sse,
+              trace_header: str | None = None):
         """Route one POST. ``sse`` is the client-side SSE surface (a
         ``_SseClient``) for streaming requests, ``None`` otherwise.
         Returns ``(status, data, content_type)`` for buffered answers,
         or ``None`` when the stream was fully handled (headers/chunks
-        already written)."""
+        already written).
+
+        ``trace_header`` is the client's raw ``X-DLlama-Trace`` (or
+        None): a valid value is adopted, anything else is replaced by a
+        freshly MINTED context — every routed request has a fleet trace
+        id from here on, and every hop below carries it."""
         streaming = sse is not None
+        ctx = TraceContext.accept(trace_header)
+        t_recv = time.perf_counter()
         key = self.affinity_key(body)
         # prompt-length class: "long" routes to a prefill-role replica
         # (disagg); short traffic keeps today's affinity/least-loaded
@@ -399,8 +555,11 @@ class FleetRouter:
                 break
             tried.add(state.rid)
             attempts += 1
+            # fresh child span id per hop, SAME trace id: each forward
+            # is its own hop in the trace, all correlated by trace_id
             verdict, a, b, c = self._forward_once(
-                state, path, body_bytes, streaming
+                state, path, body_bytes, streaming,
+                trace=ctx.child().to_header(),
             )
             if verdict == "dead":
                 self.balancer.note_dead(state.rid)
@@ -429,6 +588,15 @@ class FleetRouter:
                 len_class=len_class,
                 role=state.role,
             )
+            # the router's own span: client request received -> a
+            # replica accepted it (the fleet timeline's queue-wait row;
+            # shed/dead retries are inside this window by construction)
+            self.tracer.slice(
+                "route", "router", t_recv, args={
+                    "trace_id": ctx.trace_id, "replica": state.rid,
+                    "attempts": attempts, "len_class": len_class,
+                },
+            )
             if verdict == "ok":
                 self._pump_stream(
                     sse, a, b, state, key, path, body_bytes,
@@ -437,14 +605,22 @@ class FleetRouter:
                         and len_class == "long"
                         and state.role == "prefill"
                     ),
+                    ctx=ctx,
                 )
                 return None
             status, data, (ctype, served_by) = a, b, c
+            if status == 200:
+                # per-request phase attribution: buffered completion
+                # bodies carry summary.phases — fold it into the fleet
+                # histogram the same way streamed terminals are
+                self._harvest_phases(data)
             # the replica's attribution header passes through, so fleet
-            # clients see WHO served them even behind the router
-            extra = (
-                {"X-DLlama-Replica": served_by} if served_by else None
-            )
+            # clients see WHO served them even behind the router; the
+            # trace context goes back too — the client's key into
+            # GET /trace/<trace_id>
+            extra = {TRACE_HEADER: ctx.to_header()}
+            if served_by:
+                extra["X-DLlama-Replica"] = served_by
             return (status, data, ctype, extra)
         # every replica shed or unreachable: ONE aggregate failure with
         # the smallest outstanding hint — the router's own typed shed
@@ -465,7 +641,8 @@ class FleetRouter:
     # -- streaming pump + migration ------------------------------------------
 
     def _pump_stream(self, sse, conn, resp, state, key, path,
-                     body_bytes, handoff: bool = False) -> None:
+                     body_bytes, handoff: bool = False,
+                     ctx: TraceContext | None = None) -> None:
         """Own a streaming request end-to-end: commit the client SSE
         headers, pump the upstream body through, and on a mid-stream
         failure migrate to another replica and keep pumping — same
@@ -477,8 +654,10 @@ class FleetRouter:
         monolithic fallback, the source never stopped decoding)."""
         st = _StreamSession(key)
         st.handoff_due = handoff
+        if ctx is not None:
+            st.trace = ctx.to_header()
         tried = {state.rid}
-        sse.headers(state.rid)
+        sse.headers(state.rid, trace=st.trace)
         skip_chars = 0
         while True:
             try:
@@ -491,6 +670,7 @@ class FleetRouter:
                 conn.close()
                 return
             if outcome == "handoff":
+                t_gap = time.perf_counter()
                 nxt = self._hand_off(st, state)
                 if nxt is None:
                     # typed fallback (counted in _hand_off): the source
@@ -503,11 +683,24 @@ class FleetRouter:
                 # only now, after the reattach succeeded (closing it
                 # earlier would burn the fallback path)
                 conn.close()
+                from_rid = state.rid
                 conn, resp, state = nxt
                 tried.add(state.rid)
                 skip_chars = st.chars_out  # char-exact dedup floor
                 st.pending_error = None
                 st.terminal_seen = False
+                # the hand-off window is NOT client-visible dead air the
+                # way a migration gap is (the source kept streaming until
+                # the reattach), but the transfer is a trace row: the
+                # fleet timeline shows prefill ending and decode starting
+                # across it
+                self.tracer.slice(
+                    "disagg.handoff", "disagg", t_gap, args={
+                        "trace_id": _ctx_trace_id(ctx),
+                        "from": from_rid, "to": state.rid,
+                        "request_id": st.request_id,
+                    },
+                )
                 continue
             conn.close()
             tried.add(state.rid)
@@ -525,7 +718,8 @@ class FleetRouter:
                 # by definition. Counted as a redispatch, NOT a
                 # migration: no ticket, no deterministic replay, and
                 # the migration latency histogram must not absorb it.
-                nxt = self._redispatch(path, body_bytes, key, tried)
+                nxt = self._redispatch(path, body_bytes, key, tried,
+                                       trace=st.trace)
                 if nxt is not None:
                     st.request_id = None
                     st.ticket = None
@@ -546,29 +740,48 @@ class FleetRouter:
                 except _ClientGone:
                     pass
                 return
+            from_rid = state.rid
             conn, resp, state = nxt
             tried.add(state.rid)
             skip_chars = st.chars_out  # char-exact dedup floor
             st.pending_error = None
             st.terminal_seen = False
+            gap_s = time.perf_counter() - t0
+            # the migration gap: break detected -> resumed stream in
+            # hand. Client-visible dead air only the ROUTER saw whole —
+            # a span on the fleet timeline AND an accumulated phases
+            # field stamped into the terminal record (redispatches
+            # count too: the client's stall is the same either way)
+            st.gap_ms += gap_s * 1e3
+            self.tracer.slice(
+                "migration.gap", "migrate", t0, args={
+                    "trace_id": _ctx_trace_id(ctx),
+                    "from": from_rid, "to": state.rid,
+                    "request_id": st.request_id,
+                    "kind": "migration" if migrated else "redispatch",
+                },
+            )
             if migrated:
                 st.migrations += 1
                 self.migrations_ok += 1
                 self._m_migrations.inc(outcome="ok")
-                self._m_migration_s.observe(time.perf_counter() - t0)
+                self._m_migration_s.observe(gap_s)
 
-    def _redispatch(self, path, body_bytes, key, tried):
+    def _redispatch(self, path, body_bytes, key, tried,
+                    trace: str | None = None):
         """Re-send the ORIGINAL request to a replica not yet tried (only
         ever called with zero delivered output — a fresh request id and
         a fresh seed are invisible to the client). Returns ``(conn,
-        resp, state)`` or ``None``."""
+        resp, state)`` or ``None``. The original trace context rides
+        along: the re-dispatched request is the SAME client request,
+        so it keeps the same trace id."""
         while True:
             state = self.balancer.pick(key, exclude=tried)
             if state is None:
                 return None
             tried.add(state.rid)
             verdict, a, b, _c = self._forward_once(
-                state, path, body_bytes, True
+                state, path, body_bytes, True, trace=trace
             )
             if verdict == "ok":
                 return a, b, state
@@ -658,12 +871,32 @@ class FleetRouter:
                     # force-cancel, contained failure): migratable
                     st.pending_error = payload
                     return "migrate"
-                # natural ending (stop/length/timeout): pass through
+                # natural ending (stop/length/timeout): pass through —
+                # after stamping the router-owned attribution into the
+                # phases record and folding it into the fleet histogram
+                self._finish_phases(st, payload)
                 st.terminal_seen = True
                 sse.chunk(payload, event_id=st.deltas_out)
         except _TRANSPORT_ERRORS:
             return "migrate"  # the source replica died mid-stream
         return "done" if st.terminal_seen else "migrate"
+
+    def _finish_phases(self, st: _StreamSession, payload: dict) -> None:
+        """Stamp router-owned attribution into a terminal chunk's
+        ``summary.phases`` record — ``migration_gap_ms`` is dead air
+        only the ROUTER saw whole (the replica that finished the stream
+        never knew the break happened) — then fold the record into the
+        fleet aggregation. Best-effort: attribution never breaks a
+        stream."""
+        summ = payload.get("summary")
+        if not isinstance(summ, dict):
+            return
+        phases = summ.get("phases")
+        if not isinstance(phases, dict):
+            return
+        if st.gap_ms:
+            phases["migration_gap_ms"] = round(st.gap_ms, 3)
+        self.observe_phases(phases)
 
     def _ensure_ticket(self, st: _StreamSession, state: ReplicaState) -> None:
         """Cache the session's migration ticket (fleet/migrate.py) the
@@ -675,7 +908,8 @@ class FleetRouter:
         host, port = state.host_port()
         try:
             st.ticket = fetch_ticket(
-                host, port, st.request_id, timeout=self.connect_timeout_s
+                host, port, st.request_id, timeout=self.connect_timeout_s,
+                trace=st.trace,
             )
         except _TRANSPORT_ERRORS:
             st.ticket = None
@@ -712,6 +946,7 @@ class FleetRouter:
                 src_host, src_port, st.request_id, dst_host, dst_port,
                 timeout=self.connect_timeout_s,
                 read_timeout=self.read_timeout_s,
+                trace=st.trace,
             )
         except HandoffAborted as e:
             # covers the prefill replica dying mid-transfer (ticket or
@@ -752,7 +987,8 @@ class FleetRouter:
             host, port = state.host_port()
             try:
                 injected = inject_session(
-                    host, port, st.ticket, timeout=self.connect_timeout_s
+                    host, port, st.ticket, timeout=self.connect_timeout_s,
+                    trace=st.trace,
                 )
             except MigrationShed as e:
                 self.balancer.note_shed(state.rid, e.retry_after_s)
@@ -832,6 +1068,25 @@ class FleetRouter:
                         200, router.handle_metrics().encode(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
+                elif self.path == "/trace":
+                    # the router's OWN span ring (route slices,
+                    # migration gaps, hand-off windows)
+                    self._json(200, tracer_chrome_trace(router.tracer))
+                elif self.path.startswith("/trace/"):
+                    # cross-replica merge: ONE Perfetto timeline for a
+                    # fleet trace id — router rows at offset 0, every
+                    # reachable replica's matching events aligned by the
+                    # scraped clock-offset estimates (stamped per event)
+                    tid = self.path.rsplit("/", 1)[1].lower()
+                    if len(tid) != 32 or any(
+                        c not in "0123456789abcdef" for c in tid
+                    ):
+                        self._json(400, {
+                            "error": "bad trace id (want 32 lowercase "
+                                     "hex chars)",
+                        })
+                        return
+                    self._json(200, router.merged_trace(tid))
                 elif self.path == "/v1/models":
                     self._proxy_get("/v1/models")
                 else:
@@ -867,7 +1122,10 @@ class FleetRouter:
                     return
                 sse = _SseClient(self) if body.get("stream") else None
                 try:
-                    out = router.route(self.path, body, sse)
+                    out = router.route(
+                        self.path, body, sse,
+                        trace_header=self.headers.get(TRACE_HEADER),
+                    )
                 except _ClientGone:
                     return
                 if out is None:
@@ -890,7 +1148,8 @@ class _SseClient:
     def __init__(self, handler):
         self._h = handler
 
-    def headers(self, replica_id: str | None = None) -> None:
+    def headers(self, replica_id: str | None = None,
+                trace: str | None = None) -> None:
         try:
             h = self._h
             h.send_response(200)
@@ -901,6 +1160,10 @@ class _SseClient:
                 # first-serving replica: attribution for fleet traces
                 # (migrations are counted on the router's own /metrics)
                 h.send_header("X-DLlama-Replica", replica_id)
+            if trace:
+                # the stream's fleet trace context (minted if the client
+                # sent none): the key into GET /trace/<trace_id>
+                h.send_header(TRACE_HEADER, trace)
             h.end_headers()
         except (BrokenPipeError, ConnectionError, OSError) as e:
             raise _ClientGone from e
@@ -922,6 +1185,12 @@ class _SseClient:
             self._h.wfile.flush()
         except (BrokenPipeError, ConnectionError, OSError) as e:
             raise _ClientGone from e
+
+
+def _ctx_trace_id(ctx: TraceContext | None) -> str | None:
+    """The span-args trace id off an optional context (spans whose
+    request had no context simply omit the arg)."""
+    return ctx.trace_id if ctx is not None else None
 
 
 def _rid_from_payload(payload: dict) -> int | None:

@@ -168,6 +168,11 @@ class Request:
     # its original id with its original resolved seed)
     api_kind: str | None = None
     recovered: bool = False
+    # fleet trace context (telemetry/tracectx.py), "tid-sid" wire form:
+    # accepted from the client/router X-DLlama-Trace header, journaled
+    # with the admit record and carried by migration tickets, so spans
+    # on every replica a request touches share one trace_id
+    trace: str | None = None
     id: int = field(default_factory=_next_request_id)
     state: RequestState = RequestState.QUEUED
     future: Future = field(default_factory=Future)
@@ -683,6 +688,7 @@ class ContinuousBatchingScheduler:
             response_format=entry.response_format,
             api_kind=entry.kind,
             recovered=True,
+            trace=entry.trace,
             id=entry.request_id,
         )
 
@@ -975,7 +981,10 @@ class ContinuousBatchingScheduler:
             # recorded after the future resolves, like _finish: a lost
             # "error" finish record merely re-runs the request on
             # recovery, which is always safe
-            self.journal.record_finish(req.id, "error")
+            self.journal.record_finish(
+                req.id, "error",
+                phases=(req.summary or {}).get("phases"),
+            )
 
     def _sweep_queue(self, now: float) -> None:
         """Resolve queued requests that expired or were cancelled while
@@ -1084,11 +1093,23 @@ class ContinuousBatchingScheduler:
             # +1 reserves the slot the boundary token's own KV write needs
             # when generation runs to max_tokens exactly
             reserve = min(len(tokens) + req.max_tokens + 1, max_ctx)
+            # per-request swap-in attribution (phases record): the
+            # engine's cumulative swap_in_ms only moves inside THIS
+            # paged_admit call on this loop thread, so the delta is
+            # exactly the host-tier reactivation cost this admission paid
+            swap_ms0 = float(getattr(self.engine, "swap_in_ms", 0.0) or 0.0)
             try:
                 start = self.engine.paged_admit(
                     lane_idx, list(tokens), reserve,
                     min_share_tokens=self.prefix_min_tokens,
                 )
+                swap_ms1 = float(
+                    getattr(self.engine, "swap_in_ms", 0.0) or 0.0
+                )
+                if swap_ms1 > swap_ms0:
+                    self.telemetry.trace_of(req).swap_in_s = (
+                        (swap_ms1 - swap_ms0) / 1e3
+                    )
             except PoolExhausted as e:
                 # typed retryable shed (the 429/503 + Retry-After shape
                 # submit() sheds with), never a 500: a pool pinned by
@@ -1203,7 +1224,7 @@ class ContinuousBatchingScheduler:
             user=req.user_id, priority=int(req.priority),
             queue_timeout_s=req.queue_timeout_s, budget_s=req.budget_s,
             stream=req.on_delta is not None, kind=req.api_kind,
-            response_format=req.response_format,
+            response_format=req.response_format, trace=req.trace,
         )
         self._mirror_admit(req, admit_kw)
         if self.journal is not None:
@@ -1962,7 +1983,13 @@ class ContinuousBatchingScheduler:
             # the request on recovery (the client's Last-Event-ID filter
             # dedups), while a finish record durable BEFORE the tail
             # reached the transport would make the tail unrecoverable.
-            self.journal.record_finish(req.id, reason)
+            # The phases dict produced by on_finish rides along: the
+            # journal's finish record carries the same latency
+            # attribution the completion response does.
+            self.journal.record_finish(
+                req.id, reason,
+                phases=(req.summary or {}).get("phases"),
+            )
 
     def _run(self) -> None:
         """Supervised outer loop (failure containment, the ISSUE 8

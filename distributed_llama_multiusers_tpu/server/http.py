@@ -39,11 +39,14 @@ from __future__ import annotations
 
 import itertools
 import json
+import time
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from ..analysis import leakcheck
 from ..runtime.scheduler import Request, fresh_request_id
+from ..telemetry.tracectx import TRACE_HEADER, TraceContext
 from ..serving import (
     AdmissionRejected,
     StreamRelay,
@@ -121,13 +124,19 @@ class ApiServer:
     # -- request handling ---------------------------------------------------
 
     def _make_request(self, prompt: str, body: dict, streaming: bool,
-                      kind: str | None = None) -> tuple[Request, StreamRelay | None]:
+                      kind: str | None = None,
+                      trace: str | None = None) -> tuple[Request, StreamRelay | None]:
         """Shared Request construction for both routes (one place owns the
         body->Request field mapping). Streaming requests get a
         :class:`~..serving.resume.StreamRelay`: every delta is buffered
         with its TOKEN INDEX (the SSE ``id:`` line), which is what makes
         a stream resumable — the pump and any reconnecting client
-        address the stream by index, not by socket position."""
+        address the stream by index, not by socket position.
+
+        ``trace`` is the validated ``X-DLlama-Trace`` wire context (or
+        None): stamped onto the Request, so every span this request emits
+        carries the fleet-wide trace id and the admit journal record
+        (hence migration tickets and crash recovery) re-joins the trace."""
         params = api_types.InferenceParams.from_body(body)
         req = Request(
             prompt=prompt,
@@ -140,6 +149,7 @@ class ApiServer:
             priority=params.priority,
             response_format=params.response_format,
             api_kind=kind,
+            trace=trace,
         )
         relay = None
         if streaming:
@@ -158,21 +168,25 @@ class ApiServer:
             req.on_delta = lambda d: relay.push(len(req.generated_tokens), d)
         return req, relay
 
-    def build_request(self, body: dict, streaming: bool) -> tuple[Request, StreamRelay | None]:
+    def build_request(self, body: dict, streaming: bool,
+                      trace: str | None = None) -> tuple[Request, StreamRelay | None]:
         """Validate the body and build the Request. Raises ValueError on bad
         input — callers must do this BEFORE committing response headers."""
         messages = api_types.parse_chat_messages(body)
         chat = self.chat_template.generate(
             [ChatItem(m.role, m.content) for m in messages], append_generation_prompt=True
         )
-        return self._make_request(chat.content, body, streaming, kind="chat")
+        return self._make_request(chat.content, body, streaming, kind="chat",
+                                  trace=trace)
 
-    def build_completion_request(self, body: dict, streaming: bool) -> tuple[Request, StreamRelay | None]:
+    def build_completion_request(self, body: dict, streaming: bool,
+                                 trace: str | None = None) -> tuple[Request, StreamRelay | None]:
         """/v1/completions: the raw prompt goes straight to the scheduler —
         no chat template. Beyond reference parity (the fork serves only
         the chat route, src/dllama-api.cpp:338-349)."""
         prompt = api_types.parse_completion_prompt(body)
-        return self._make_request(prompt, body, streaming, kind="completion")
+        return self._make_request(prompt, body, streaming, kind="completion",
+                                  trace=trace)
 
     def handle_chat_completion(self, body: dict, send_chunk=None, prepared=None) -> dict:
         """Run a (pre-validated) request through the shared batching loop.
@@ -475,6 +489,16 @@ class ApiServer:
             out["pool_pages_free"] = ps.get("pool_pages_free", 0)
             out["pool_pages_total"] = ps.get("pool_pages_total", 0)
             out["pool_parked_pages"] = ps.get("pool_parked_pages", 0)
+        # clock-offset anchor for the fleet trace merge: this replica's
+        # CURRENT position on its /trace timebase (µs since the span
+        # tracer's perf_counter origin — the same rebasing chrome_trace
+        # applies). The router brackets the scrape with its own clock and
+        # estimates offset = local_midpoint − this stamp, uncertainty =
+        # RTT/2; perf_counter origins are per-process, so there is no
+        # cross-host clock to read directly.
+        out["trace_clock_us"] = round(
+            (time.perf_counter() - self._telemetry().tracer.origin) * 1e6, 1
+        )
         return out
 
     def _telemetry(self):
@@ -497,9 +521,16 @@ class ApiServer:
         two endpoints reconcile (docs/OBSERVABILITY.md)."""
         return self._telemetry().render_prometheus(bridge=self.handle_stats())
 
-    def handle_trace(self) -> dict:
-        """The span ring as Chrome trace-event JSON (Perfetto loadable)."""
-        return self._telemetry().chrome_trace()
+    def handle_trace(self, since: int = 0,
+                     trace_id: str | None = None) -> dict:
+        """The span ring as Chrome trace-event JSON (Perfetto loadable).
+
+        ``since`` (the doc's top-level ``cursor`` from a prior pull)
+        returns only newer events — incremental polling instead of
+        re-downloading the whole ring; ``trace_id`` returns only the
+        events of one fleet trace (what the router's cross-replica merge
+        pulls per replica)."""
+        return self._telemetry().chrome_trace(since=since, trace_id=trace_id)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -620,9 +651,22 @@ class ApiServer:
                         200, api.handle_metrics().encode(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
-                elif self.path == "/trace":
-                    # Chrome trace-event JSON: save and load in Perfetto
-                    self._json(200, api.handle_trace())
+                elif self.path.split("?", 1)[0] == "/trace":
+                    # Chrome trace-event JSON: save and load in Perfetto.
+                    # ?since=<cursor> returns only newer events (the
+                    # response's top-level `cursor` is the resume point);
+                    # ?trace_id=<32-hex> filters to one fleet trace (what
+                    # the router's /trace/<id> merge pulls per replica)
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        since = int(q.get("since", ["0"])[0])
+                    except ValueError:
+                        self._json(400, {"error": "bad since cursor"})
+                        return
+                    trace_id = q.get("trace_id", [None])[0]
+                    self._json(200, api.handle_trace(
+                        since=since, trace_id=trace_id,
+                    ))
                 elif self.path in ("/", "/health"):
                     # readiness: flips to 503 during drain so load balancers
                     # stop routing here while in-flight work finishes — and
@@ -923,6 +967,12 @@ class ApiServer:
                     self._admin_kvimport(body)
                     return
                 build_fn, handle_fn = route
+                # fleet trace context: accept a VALID X-DLlama-Trace wire
+                # value (the router mints one per request; clients may
+                # send their own); malformed/absent values are dropped —
+                # tracing never fails or sheds a request
+                ctx = TraceContext.parse(self.headers.get(TRACE_HEADER))
+                trace = ctx.to_header() if ctx is not None else None
                 # request id in EVERY failure payload once a Request exists
                 # (satellite: a streamed failure must correlate with the
                 # server's per-request log lines); None before build_fn
@@ -939,7 +989,7 @@ class ApiServer:
                         # validate AND submit BEFORE committing SSE headers so
                         # bad input still gets a proper 400 and a shed request
                         # (queue full / draining) a proper 429/503
-                        prepared = build_fn(body, streaming=True)
+                        prepared = build_fn(body, streaming=True, trace=trace)
                         req = prepared[0]
                         try:
                             api.scheduler.submit(req)
@@ -973,7 +1023,7 @@ class ApiServer:
                             self._sse_chunk(err({"error": str(e)}))
                             self.wfile.write(b"data: [DONE]\n\n")
                     else:
-                        prepared = build_fn(body, streaming=False)
+                        prepared = build_fn(body, streaming=False, trace=trace)
                         req = prepared[0]
                         self._json(200, handle_fn(body, prepared=prepared))
                 except AdmissionRejected as e:  # shed before any headers
@@ -1008,6 +1058,13 @@ class ApiServer:
 
                 id_host = _socket.gethostname()
             self.replica_id = f"{id_host}:{httpd.server_address[1]}"
+        # fleet span attribution: once the replica's identity is known,
+        # every span the hub emits carries it as a `replica` arg — the
+        # merged fleet timeline needs each event to name its source even
+        # after docs from several replicas are interleaved
+        tel = self._telemetry()
+        if getattr(tel, "replica", None) is None:
+            tel.replica = self.replica_id
         self._httpd = httpd
         return httpd
 

@@ -68,7 +68,8 @@ def admit_record(*, request_id: int, prompt: str, tokens: list[int],
                  add_special_tokens: bool, user: str | None, priority: int,
                  queue_timeout_s: float | None, budget_s: float | None,
                  stream: bool, kind: str | None = None,
-                 response_format: dict | None = None) -> dict:
+                 response_format: dict | None = None,
+                 trace: str | None = None) -> dict:
     """THE admit wire record — one field-mapping site shared by
     :meth:`RequestJournal.record_admit` (the on-disk journal) and the
     scheduler's live-session mirror (``export_session``, the fleet
@@ -94,6 +95,11 @@ def admit_record(*, request_id: int, prompt: str, tokens: list[int],
         # schema). None for unconstrained requests (old journals decode
         # with the same default).
         "response_format": response_format,
+        # fleet trace context (telemetry/tracectx.py, "tid-sid" wire
+        # form): because this single encoding site also feeds the
+        # migration ticket, a recovered OR migrated stream rejoins its
+        # original trace instead of starting a fresh one
+        "trace": None if trace is None else str(trace),
     }
 
 
@@ -148,9 +154,11 @@ class JournalEntry:
     stream: bool = False
     kind: str | None = None  # "chat" | "completion" | None (CLI/bench)
     response_format: dict | None = None  # structured output (grammar/)
+    trace: str | None = None  # fleet trace context, "tid-sid" wire form
     watermark: int = 0  # tokens already delivered to the client transport
     finished: bool = False
     finish_reason: str | None = None
+    phases: dict | None = None  # latency attribution off the finish record
 
 
 class JournalImage:
@@ -196,6 +204,11 @@ class JournalImage:
                     if isinstance(rec.get("response_format"), dict)
                     else None
                 ),
+                trace=(
+                    str(rec["trace"])
+                    if isinstance(rec.get("trace"), str)
+                    else None
+                ),
             )
             if prev is not None:
                 # a recovered request re-journals on re-admission: its
@@ -213,6 +226,8 @@ class JournalImage:
             if e is not None:
                 e.finished = True
                 e.finish_reason = rec.get("reason")
+                if isinstance(rec.get("phases"), dict):
+                    e.phases = dict(rec["phases"])
         else:
             self.skipped += 1
 
@@ -365,7 +380,8 @@ class RequestJournal:
                      priority: int,
                      queue_timeout_s: float | None, budget_s: float | None,
                      stream: bool, kind: str | None = None,
-                     response_format: dict | None = None) -> None:
+                     response_format: dict | None = None,
+                     trace: str | None = None) -> None:
         """One admitted request, with the RESOLVED seed — everything a
         deterministic replay needs to regenerate the identical stream."""
         with self._lock:
@@ -381,7 +397,7 @@ class RequestJournal:
             add_special_tokens=add_special_tokens, user=user,
             priority=priority, queue_timeout_s=queue_timeout_s,
             budget_s=budget_s, stream=stream, kind=kind,
-            response_format=response_format,
+            response_format=response_format, trace=trace,
         ))
 
     def note_progress(self, request_id: int, tokens_delivered: int) -> None:
@@ -409,12 +425,18 @@ class RequestJournal:
             "n": int(tokens_delivered),
         })
 
-    def record_finish(self, request_id: int, reason: str | None) -> None:
+    def record_finish(self, request_id: int, reason: str | None,
+                      phases: dict | None = None) -> None:
+        """The finish record; ``phases`` (when the scheduler hands one)
+        is the per-request latency attribution dict — journaled so
+        post-mortem analysis of a crashed window has the same phase
+        numbers the completion response carried."""
         with self._lock:
             self._j_progress_mark.pop(int(request_id), None)
-        self._enqueue({
-            "k": "finish", "id": int(request_id), "reason": reason,
-        })
+        rec = {"k": "finish", "id": int(request_id), "reason": reason}
+        if phases:
+            rec["phases"] = dict(phases)
+        self._enqueue(rec)
 
     def _enqueue(self, rec: dict) -> None:
         with self._cv:

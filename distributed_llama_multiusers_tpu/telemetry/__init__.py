@@ -36,11 +36,24 @@ from .metrics import (
     Counter,
     Gauge,
     Histogram,
+    LabelledHistogram,
     MetricsRegistry,
     log_buckets,
 )
 from .spans import RequestTrace, SpanEvent, SpanTracer
-from .trace import chrome_trace, dump_chrome_trace, tracer_chrome_trace
+from .trace import (
+    chrome_trace,
+    dump_chrome_trace,
+    merge_chrome_traces,
+    tracer_chrome_trace,
+)
+from .tracectx import (
+    PHASE_KEYS,
+    TRACE_HEADER,
+    PhaseAccumulator,
+    TraceContext,
+    trace_id_of,
+)
 
 __all__ = [
     "Counter",
@@ -48,15 +61,22 @@ __all__ = [
     "Histogram",
     "JsonLogger",
     "LATENCY_BUCKETS_S",
+    "LabelledHistogram",
     "MetricsRegistry",
+    "PHASE_KEYS",
+    "PhaseAccumulator",
     "RequestTrace",
     "SpanEvent",
     "SpanTracer",
+    "TRACE_HEADER",
     "Telemetry",
+    "TraceContext",
     "chrome_trace",
     "default_logger",
     "dump_chrome_trace",
     "log_buckets",
     "log_event",
+    "merge_chrome_traces",
+    "trace_id_of",
     "tracer_chrome_trace",
 ]

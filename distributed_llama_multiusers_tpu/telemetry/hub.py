@@ -33,6 +33,7 @@ from .logs import JsonLogger, default_logger
 from .metrics import LATENCY_BUCKETS_S, MetricsRegistry
 from .spans import RequestTrace, SpanTracer
 from .trace import dump_chrome_trace, tracer_chrome_trace
+from .tracectx import trace_id_of
 
 STATS_PREFIX = "dllama_stats_"
 
@@ -44,10 +45,16 @@ class Telemetry:
         registry: MetricsRegistry | None = None,
         logger: JsonLogger | None = None,
         trace_capacity: int = 16384,
+        replica: str | None = None,
     ):
         self.tracer = tracer or SpanTracer(capacity=trace_capacity)
         self.registry = registry or MetricsRegistry()
         self.logger = logger or default_logger()
+        # replica attribution on every span (ISSUE 20): the merged
+        # cross-replica timeline needs each event to say where it ran.
+        # Set at construction or later by the server once it knows its id
+        # (ApiServer stamps it when the scheduler built its own hub).
+        self.replica = replica
         reg = self.registry
         self.ttft = reg.histogram(
             "dllama_ttft_seconds",
@@ -212,10 +219,24 @@ class Telemetry:
             tel = req.tel = RequestTrace(getattr(req, "submitted_at", None))
         return tel
 
+    def span_args(self, req=None, extra: dict | None = None) -> dict | None:
+        """The args every span carries since ISSUE 20: the request's
+        fleet-wide ``trace_id`` (when it carried an ``X-DLlama-Trace``
+        context) and this process's ``replica`` id — what the router's
+        cross-replica merge filters and attributes by."""
+        args = dict(extra) if extra else {}
+        if req is not None:
+            tid = trace_id_of(getattr(req, "trace", None))
+            if tid:
+                args["trace_id"] = tid
+        if self.replica:
+            args["replica"] = self.replica
+        return args or None
+
     def on_submit(self, req) -> None:
         tel = self.trace_of(req)
         self.tracer.instant("submitted", "queue", ts=tel.span_t0,
-                            req_id=req.id)
+                            req_id=req.id, args=self.span_args(req))
 
     def on_admit(self, req, lane: int) -> None:
         tel = self.trace_of(req)
@@ -223,7 +244,8 @@ class Telemetry:
         tel.lane = lane
         now_pc = self.tracer.now()
         self.tracer.slice("queued", "queue", tel.span_t0, now_pc,
-                          req_id=req.id, args={"lane": lane})
+                          req_id=req.id,
+                          args=self.span_args(req, {"lane": lane}))
         tel.span_t0 = now_pc  # the generate slice starts here
 
     def on_queue_pop(self, req, now: float) -> None:
@@ -249,7 +271,8 @@ class Telemetry:
         now_pc = self.tracer.now()
         self.tracer.slice(
             "prefill.fused" if fused else "prefill.sync", f"lane{lane}",
-            t0, now_pc, req_id=req.id, args={"tokens": n_tokens},
+            t0, now_pc, req_id=req.id,
+            args=self.span_args(req, {"tokens": n_tokens}),
         )
         if not fused:
             # fused chunks ride a pipelined dispatch that on_pipelined_step
@@ -276,7 +299,8 @@ class Telemetry:
     def on_step(self, kind: str, t0: float, args: dict | None = None) -> None:
         """One synchronous engine dispatch (kind: sync/spec/multi)."""
         now_pc = self.tracer.now()
-        self.tracer.slice(f"step.{kind}", "pipeline", t0, now_pc, args=args)
+        self.tracer.slice(f"step.{kind}", "pipeline", t0, now_pc,
+                          args=self.span_args(extra=args))
         self.step_duration.observe(max(0.0, now_pc - t0))
 
     def on_pipelined_step(self, t_dispatch: float, fused_info=None,
@@ -292,7 +316,7 @@ class Telemetry:
         now_pc = self.tracer.now()
         if fused_info is None:
             self.tracer.slice(f"step.{kind}", "pipeline", t_dispatch,
-                              now_pc)
+                              now_pc, args=self.span_args())
         else:
             lane_idx, lane, final, n_chunk = fused_info
             req = lane.request
@@ -303,8 +327,8 @@ class Telemetry:
             # fused slices
             name = "step.fused" if kind == "pipelined" else "step.spec_fused"
             self.tracer.slice(
-                name, "pipeline", t_dispatch, now_pc,
-                req_id=req_id, args={"chunk": n_chunk, "final": final},
+                name, "pipeline", t_dispatch, now_pc, req_id=req_id,
+                args=self.span_args(req, {"chunk": n_chunk, "final": final}),
             )
             if req is not None:
                 self.on_prefill_chunk(req, lane_idx, t_dispatch, n_chunk,
@@ -324,8 +348,10 @@ class Telemetry:
             self.sync_seconds.observe(ms / 1e3)
 
     def on_flush(self, live: int, admitting: int) -> None:
-        self.tracer.instant("pipeline.flush", "pipeline",
-                            args={"live": live, "admitting": admitting})
+        self.tracer.instant(
+            "pipeline.flush", "pipeline",
+            args=self.span_args(extra={"live": live, "admitting": admitting}),
+        )
 
     # -- failure containment -------------------------------------------------
 
@@ -338,11 +364,11 @@ class Telemetry:
         alarms fire."""
         self.tracer.instant(
             "engine.failure", "pipeline",
-            args={
+            args=self.span_args(extra={
                 "error": error[:200],
                 "lanes_failed": lanes_failed,
                 "breaker_state": breaker_state,
-            },
+            }),
         )
         self.logger.emit(
             "engine_failure",
@@ -358,7 +384,9 @@ class Telemetry:
         instant tying the trip to the pipeline track."""
         self.tracer.instant(
             "watchdog.trip", "pipeline",
-            args={"waited_s": round(waited_s, 3), "fatal": fatal},
+            args=self.span_args(
+                extra={"waited_s": round(waited_s, 3), "fatal": fatal}
+            ),
         )
 
     # -- request endings -----------------------------------------------------
@@ -378,8 +406,10 @@ class Telemetry:
         tel = self.trace_of(req)
         track = f"lane{lane}"
         self.tracer.slice("generate", track, tel.span_t0, req_id=req.id,
-                          args={"finish_reason": reason})
-        self.tracer.instant(f"finish.{reason}", track, req_id=req.id)
+                          args=self.span_args(req,
+                                              {"finish_reason": reason}))
+        self.tracer.instant(f"finish.{reason}", track, req_id=req.id,
+                            args=self.span_args(req))
         self.requests_finished.inc(finish_reason=str(reason))
         self._summarize(req, reason)
 
@@ -388,8 +418,10 @@ class Telemetry:
         cancel while queued, drain shed)."""
         tel = self.trace_of(req)
         self.tracer.slice("queued", "queue", tel.span_t0, req_id=req.id,
-                          args={"finish_reason": reason})
-        self.tracer.instant(f"finish.{reason}", "queue", req_id=req.id)
+                          args=self.span_args(req,
+                                              {"finish_reason": reason}))
+        self.tracer.instant(f"finish.{reason}", "queue", req_id=req.id,
+                            args=self.span_args(req))
         self.requests_finished.inc(finish_reason=reason)
         self._summarize(req, reason)
 
@@ -399,7 +431,8 @@ class Telemetry:
         so the request's log record carries the reason the 500 names."""
         track = "queue" if lane is None else f"lane{lane}"
         self.tracer.instant("finish.error", track, req_id=req.id,
-                            args={"error": error[:200]})
+                            args=self.span_args(req,
+                                                {"error": error[:200]}))
         self.requests_finished.inc(finish_reason="error")
         self._summarize(req, "error", error=error[:200])
 
@@ -520,8 +553,10 @@ class Telemetry:
             self.bridge_stats(bridge)
         return self.registry.render()
 
-    def chrome_trace(self) -> dict:
-        return tracer_chrome_trace(self.tracer)
+    def chrome_trace(self, since: int = 0,
+                     trace_id: str | None = None) -> dict:
+        return tracer_chrome_trace(self.tracer, since=since,
+                                   trace_id=trace_id)
 
     def dump_trace(self, path: str) -> dict:
         doc = dump_chrome_trace(self.tracer, path)

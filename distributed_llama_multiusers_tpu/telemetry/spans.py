@@ -24,9 +24,10 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from ..lockcheck import make_lock
+from .tracectx import trace_id_of
 
 
 # span/instant names, for reference (docs/OBSERVABILITY.md lists them all):
@@ -53,6 +54,10 @@ class SpanEvent:
     track: str
     req_id: int | None = None
     args: dict | None = None
+    # monotone per-tracer event cursor (assigned at append): pollers pass
+    # the last seq they saw as /trace's `since=` param and stop
+    # re-downloading the whole ring every scrape
+    seq: int = 0
 
 
 class SpanTracer:
@@ -61,7 +66,10 @@ class SpanTracer:
     # dlint guarded-by declaration (analysis/lock_check.py): ring state
     # only under `_trace_lock`. Machine-checked by `make lint`.
     _dlint_guarded_by = {
-        ("_trace_lock",): ("_trace_ring", "_trace_dropped", "_trace_total"),
+        ("_trace_lock",): (
+            "_trace_ring", "_trace_dropped", "_trace_total", "_trace_seq",
+            "_trace_dropped_by_track",
+        ),
     }
 
     def __init__(self, capacity: int = 16384):
@@ -72,17 +80,28 @@ class SpanTracer:
         # witness-wrappable (DLLAMA_LOCKCHECK=1): the literal names the
         # class-qualified declaration, cross-checked by dlint lock-order
         self._trace_lock = make_lock("SpanTracer._trace_lock")
-        self._trace_ring: deque[SpanEvent] = deque(maxlen=self.capacity)
+        # eviction is explicit (not deque maxlen) so drops attribute to
+        # the track they truncated — a silently shortened lane track is
+        # the failure mode per-track counts exist to make visible
+        self._trace_ring: deque[SpanEvent] = deque()
         self._trace_dropped = 0
+        self._trace_dropped_by_track: dict[str, int] = {}
         self._trace_total = 0
+        self._trace_seq = 0
 
     def now(self) -> float:
         return time.perf_counter()
 
     def _append(self, ev: SpanEvent) -> None:
         with self._trace_lock:
-            if len(self._trace_ring) == self.capacity:
-                self._trace_dropped += 1  # maxlen evicts the oldest
+            self._trace_seq += 1
+            ev = replace(ev, seq=self._trace_seq)
+            if len(self._trace_ring) >= self.capacity:
+                old = self._trace_ring.popleft()
+                self._trace_dropped += 1
+                self._trace_dropped_by_track[old.track] = (
+                    self._trace_dropped_by_track.get(old.track, 0) + 1
+                )
             self._trace_ring.append(ev)
             self._trace_total += 1
 
@@ -101,19 +120,38 @@ class SpanTracer:
             ts = time.perf_counter()
         self._append(SpanEvent(name, "i", ts, 0.0, track, req_id, args))
 
-    def snapshot(self) -> list[SpanEvent]:
-        """Point-in-time copy of the ring, oldest first."""
+    def snapshot(self, since: int = 0,
+                 trace_id: str | None = None) -> list[SpanEvent]:
+        """Point-in-time copy of the ring, oldest first.
+
+        ``since`` keeps only events with ``seq`` strictly greater (the
+        /trace poller cursor); ``trace_id`` keeps only events whose args
+        carry that trace id (the cross-replica merge filter)."""
         with self._trace_lock:
-            return list(self._trace_ring)
+            events = list(self._trace_ring)
+        if since:
+            events = [e for e in events if e.seq > since]
+        if trace_id is not None:
+            events = [
+                e for e in events
+                if e.args is not None and e.args.get("trace_id") == trace_id
+            ]
+        return events
 
     def counts(self) -> dict:
-        """{recorded, dropped, buffered} — surfaced on /stats so an
-        evicting ring is visible, not silent."""
+        """{recorded, dropped, buffered, cursor, per-track drops} —
+        surfaced on /stats so an evicting ring is visible, not silent,
+        and a truncated track is attributable (dict-valued: the stats
+        bridge republishes it as ``{key="..."}``-labelled gauges)."""
         with self._trace_lock:
             return {
                 "trace_events_recorded": self._trace_total,
                 "trace_events_dropped": self._trace_dropped,
                 "trace_events_buffered": len(self._trace_ring),
+                "trace_events_cursor": self._trace_seq,
+                "trace_events_dropped_by_track": dict(
+                    self._trace_dropped_by_track
+                ),
             }
 
 
@@ -128,7 +166,7 @@ class RequestTrace:
     __slots__ = (
         "submitted_at", "admitted_at", "first_token_at", "last_token_at",
         "gaps", "n_tokens", "fused_admitted", "prefix_saved",
-        "span_t0", "lane",
+        "span_t0", "lane", "swap_in_s", "sync_s",
     )
 
     def __init__(self, submitted_at: float | None = None):
@@ -146,6 +184,11 @@ class RequestTrace:
         # span clock (perf_counter) for the lifecycle slices
         self.span_t0 = time.perf_counter()
         self.lane: int | None = None
+        # phase attribution extras: host-tier swap-in cost paid at this
+        # request's admission, and measured per-request collective time
+        # (mesh runs only — stays 0 off-mesh)
+        self.swap_in_s = 0.0
+        self.sync_s = 0.0
 
     def on_token(self, now: float) -> None:
         """Stamp one consumed token (``now`` = time.monotonic())."""
@@ -178,12 +221,44 @@ class RequestTrace:
         idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
         return ordered[idx]
 
+    def phases(self) -> dict:
+        """Per-request phase attribution (milliseconds): where this
+        request's wall time went, phase by phase. Attached to completion
+        responses and the journal finish record, and aggregated
+        router-side into ``dllama_request_phase_seconds``.
+
+        ``migration_gap_ms`` is 0 at this producer by construction — a
+        replica cannot see its own death; the router stamps the measured
+        gap into the record it forwards when a stream was spliced."""
+        ms = lambda v: 0.0 if v is None else round(max(0.0, v) * 1e3, 3)
+        prefill_s = None
+        if self.admitted_at is not None and self.first_token_at is not None:
+            prefill_s = self.first_token_at - self.admitted_at
+        decode_s = None
+        if self.first_token_at is not None and self.last_token_at is not None:
+            decode_s = self.last_token_at - self.first_token_at
+        total_s = None
+        if self.last_token_at is not None:
+            total_s = self.last_token_at - self.submitted_at
+        return {
+            "queue_wait_ms": ms(self.queued_s),
+            "prefill_ms": ms(prefill_s),
+            "decode_ms": ms(decode_s),
+            "itl_p50_ms": ms(self.tbt_quantile(0.50)),
+            "itl_p99_ms": ms(self.tbt_quantile(0.99)),
+            "migration_gap_ms": 0.0,
+            "swap_in_ms": ms(self.swap_in_s),
+            "sync_ms": ms(self.sync_s),
+            "ttft_ms": ms(self.ttft_s),
+            "total_ms": ms(total_s),
+        }
+
     def summary(self, req, finish_reason: str | None) -> dict:
         """The per-request summary attached to completion responses and
         emitted as the request's JSON log line — identical between the
         stream and non-stream paths by construction (one producer)."""
         rnd = lambda v: None if v is None else round(v, 6)
-        return {
+        out = {
             "request_id": req.id,
             "finish_reason": finish_reason,
             "queued_s": rnd(self.queued_s),
@@ -194,4 +269,12 @@ class RequestTrace:
             "n_generated_tokens": len(req.generated_tokens),
             "prefix_tokens_saved": self.prefix_saved,
             "fused_admitted": self.fused_admitted,
+            "phases": self.phases(),
         }
+        # requests carry the wire-form context ("<trace>-<span>", the
+        # X-DLlama-Trace value); the summary surfaces just the trace id,
+        # the key clients and the router correlate on
+        trace_id = trace_id_of(getattr(req, "trace", None))
+        if trace_id:
+            out["trace_id"] = trace_id
+        return out

@@ -1930,3 +1930,110 @@ def test_cli_missing_path_is_usage_error(tmp_path):
 def test_syntax_error_is_a_parse_finding(tmp_path):
     findings = run_on(tmp_path, {"mod.py": "def broken(:\n"})
     assert checks_of(findings) == ["parse"]
+
+
+# -- fleet tracing (ISSUE 20) -------------------------------------------------
+
+
+def test_host_sync_covers_tracectx(tmp_path):
+    """ISSUE-20 satellite: the fleet trace context rides every router
+    hop and the replica admission path (journal admit records), so it is
+    registered under host-sync like the rest of telemetry/ — a transfer
+    spelling there would mean device state leaked into the tracing
+    layer. Known-bad fixtures flag; the real idiom (os.urandom ids,
+    dict folding under a lock) stays clean."""
+    findings = run_on(tmp_path, {"telemetry/tracectx.py": """
+        import numpy as np
+
+        def observe(phases):
+            return np.asarray(list(phases.values()))
+    """})
+    assert checks_of(findings) == ["host-sync"]
+    findings = run_on(tmp_path / "b", {"telemetry/tracectx.py": """
+        def fold(totals, v):
+            totals.append(v.item())
+    """})
+    assert checks_of(findings) == ["host-sync"]
+    # the clean shape: the shipped module's real idiom
+    clean = run_on(tmp_path / "c", {"telemetry/tracectx.py": """
+        import os
+        import threading
+
+        def mint():
+            return os.urandom(16).hex() + "-" + os.urandom(8).hex()
+
+        class PhaseAccumulator:
+            _dlint_guarded_by = {("_phase_lock",): ("_phase_counts",)}
+
+            def __init__(self):
+                self._phase_lock = threading.Lock()
+                self._phase_counts = {}
+
+            def observe(self, key):
+                with self._phase_lock:
+                    self._phase_counts[key] = (
+                        self._phase_counts.get(key, 0) + 1
+                    )
+    """})
+    assert clean == []
+
+
+def test_real_tracing_guard_decls_are_collected():
+    """Rot-guard for ISSUE 20's lock declarations: the shipped
+    PhaseAccumulator, LabelledHistogram, and FleetRouter clock-offset
+    declarations reach the guarded-by checker — the declaration syntax
+    must not silently rot out of collection."""
+    import ast
+
+    from distributed_llama_multiusers_tpu.analysis.core import (
+        Project,
+        SourceFile,
+    )
+    from distributed_llama_multiusers_tpu.analysis.lock_check import (
+        GuardedByChecker,
+    )
+
+    def collected(rel):
+        project = Project()
+        checker = GuardedByChecker()
+        p = PACKAGE_ROOT / rel
+        sf = SourceFile(path=p, display=rel, text=p.read_text(),
+                        tree=ast.parse(p.read_text()))
+        checker.collect(sf, project)
+        return project.guarded
+
+    guarded = collected("telemetry/tracectx.py")
+    for attr in ("_phase_counts", "_phase_sums_ms", "_phase_records"):
+        assert attr in guarded, attr
+        assert guarded[attr][0] == frozenset({"_phase_lock"})
+    guarded = collected("telemetry/metrics.py")
+    assert "_hist_series" in guarded
+    assert guarded["_hist_series"][0] == frozenset({"_m_lock"})
+    guarded = collected("fleet/router.py")
+    assert "_clock_offsets" in guarded
+    assert guarded["_clock_offsets"][0] == frozenset({"_clock_lock"})
+
+
+def test_guarded_by_flags_unlocked_phase_state(tmp_path):
+    """Known-bad: phase-aggregation state read outside the accumulator
+    lock (the router's stream pumps fold records from many client
+    threads) is a finding; the locked shape is clean."""
+    findings = run_on(tmp_path, {"telemetry/tracectx.py": """
+        import threading
+
+        class PhaseAccumulator:
+            _dlint_guarded_by = {("_phase_lock",): ("_phase_counts",)}
+
+            def __init__(self):
+                self._phase_lock = threading.Lock()
+                self._phase_counts = {}
+
+            def bad_snapshot(self):
+                return dict(self._phase_counts)
+
+            def good_snapshot(self):
+                with self._phase_lock:
+                    return dict(self._phase_counts)
+    """})
+    assert checks_of(findings) == ["guarded-by"]
+    assert "_phase_counts" in findings[0].message
